@@ -1,0 +1,23 @@
+"""predictionio_tpu — a TPU-native ML serving framework.
+
+A ground-up re-design of the capabilities of Apache PredictionIO
+(reference: /root/reference) for JAX/XLA on TPU:
+
+- Event collection REST server with pluggable event storage
+  (reference: data/src/main/scala/.../data/api/EventServer.scala)
+- Typed DASE pipeline: DataSource -> Preparator -> Algorithm -> Serving
+  (reference: core/src/main/scala/.../controller/Engine.scala:82)
+- Train / eval / deploy / batch-predict workflows
+  (reference: core/src/main/scala/.../workflow/CoreWorkflow.scala)
+- Model checkpointing + engine-instance registry
+- Low-latency prediction server with device-resident parameters
+- Offline evaluation harness with hyperparameter sweeps
+
+Where the reference runs every compute stage as Spark RDD jobs on a JVM
+cluster, this framework runs them as JAX/XLA programs sharded with
+pjit/shard_map over a TPU mesh.
+"""
+
+from predictionio_tpu.version import __version__
+
+__all__ = ["__version__"]

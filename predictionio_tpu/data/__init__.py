@@ -1,0 +1,24 @@
+"""Event data model, storage SPI, and event store facades.
+
+Reference layer 2: data/src/main/scala/org/apache/predictionio/data/storage/.
+"""
+
+from predictionio_tpu.data.datamap import DataMap, DataMapError, PropertyMap
+from predictionio_tpu.data.event import Event, EventValidationError, validate_event
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.aggregator import (
+    aggregate_properties,
+    aggregate_properties_single,
+)
+
+__all__ = [
+    "BiMap",
+    "DataMap",
+    "DataMapError",
+    "Event",
+    "EventValidationError",
+    "PropertyMap",
+    "aggregate_properties",
+    "aggregate_properties_single",
+    "validate_event",
+]

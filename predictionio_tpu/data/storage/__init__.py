@@ -1,0 +1,43 @@
+"""Storage SPI: env-var driven backend registry.
+
+Mirrors the reference's Storage object (data/.../storage/Storage.scala:146):
+storage *sources* are declared via ``PIO_STORAGE_SOURCES_<NAME>_TYPE`` (+
+backend-specific keys like ``_PATH``/``_URL``), and the three *repositories*
+(METADATA, EVENTDATA, MODELDATA) bind to a source via
+``PIO_STORAGE_REPOSITORIES_<REPO>_{NAME,SOURCE}``.  Unset environments fall
+back to a self-contained local setup under ``$PIO_HOME`` (default
+``~/.predictionio_tpu``): sqlite for metadata+events, local filesystem for
+model blobs.
+"""
+
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+    EventFrame,
+    LEvents,
+    PEvents,
+)
+from predictionio_tpu.data.storage.config import (
+    StorageConfig,
+    StorageRuntime,
+    get_storage,
+    reset_storage,
+)
+
+__all__ = [
+    "AccessKey",
+    "App",
+    "Channel",
+    "EngineInstance",
+    "EvaluationInstance",
+    "EventFrame",
+    "LEvents",
+    "PEvents",
+    "StorageConfig",
+    "StorageRuntime",
+    "get_storage",
+    "reset_storage",
+]

@@ -1,0 +1,528 @@
+"""Storage DAO contracts and metadata entities.
+
+The reference defines DAO traits LEvents (data/.../storage/LEvents.scala:40),
+PEvents (PEvents.scala:38) and metadata DAOs Apps/AccessKeys/Channels/
+EngineInstances/EvaluationInstances/Models.  This module is their TPU-native
+contract: the "P" side does not return RDDs but **EventFrame** — a columnar
+numpy batch that stages directly into ``jax.device_put`` — which is the
+framework's Spark-replacement seam.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field, replace
+from datetime import datetime, timezone
+from typing import Any, Iterable, Iterator, Sequence
+
+import numpy as np
+
+from predictionio_tpu.data.aggregator import aggregate_properties
+from predictionio_tpu.data.datamap import DataMap, PropertyMap
+from predictionio_tpu.data.event import Event
+
+# ---------------------------------------------------------------------------
+# Metadata entities (data/.../storage/{Apps,AccessKeys,Channels,...}.scala)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class App:
+    id: int
+    name: str
+    description: str | None = None
+
+
+@dataclass(frozen=True)
+class AccessKey:
+    key: str
+    appid: int
+    events: tuple[str, ...] = ()  # empty = all events allowed
+
+
+@dataclass(frozen=True)
+class Channel:
+    id: int
+    name: str
+    appid: int
+
+    def __post_init__(self):
+        if not channel_name_is_valid(self.name):
+            raise ValueError(
+                f"invalid channel name {self.name!r}: must be 1-16 chars of "
+                "[a-zA-Z0-9-]"
+            )
+
+
+def channel_name_is_valid(name: str) -> bool:
+    """Channel naming rule from the reference (Channels.scala: 1-16 word chars/hyphen)."""
+    if not 1 <= len(name) <= 16:
+        return False
+    return all(c.isalnum() or c == "-" for c in name)
+
+
+@dataclass(frozen=True)
+class EngineInstance:
+    """Record of one training run — the deploy/resume handle.
+
+    Mirrors EngineInstances.scala:46: every parameter that produced the model
+    is frozen into this row as JSON.
+    """
+
+    id: str
+    status: str  # INIT | TRAINING | COMPLETED | FAILED
+    start_time: datetime
+    end_time: datetime
+    engine_id: str
+    engine_version: str
+    engine_variant: str
+    engine_factory: str
+    batch: str = ""
+    env: dict[str, str] = field(default_factory=dict)
+    mesh_conf: dict[str, Any] = field(default_factory=dict)  # sparkConf analog
+    datasource_params: str = "{}"
+    preparator_params: str = "{}"
+    algorithms_params: str = "[]"
+    serving_params: str = "{}"
+
+    def completed(self) -> "EngineInstance":
+        return replace(
+            self, status="COMPLETED", end_time=datetime.now(tz=timezone.utc)
+        )
+
+
+@dataclass(frozen=True)
+class EvaluationInstance:
+    """Record of one evaluation run (EvaluationInstances.scala:42)."""
+
+    id: str
+    status: str  # INIT | EVALUATING | EVALCOMPLETED | FAILED
+    start_time: datetime
+    end_time: datetime
+    evaluation_class: str = ""
+    engine_params_generator_class: str = ""
+    batch: str = ""
+    env: dict[str, str] = field(default_factory=dict)
+    evaluator_results: str = ""  # one-liner
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = ""
+
+
+class Apps(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, app: App) -> int | None: ...
+
+    @abc.abstractmethod
+    def get(self, app_id: int) -> App | None: ...
+
+    @abc.abstractmethod
+    def get_by_name(self, name: str) -> App | None: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[App]: ...
+
+    @abc.abstractmethod
+    def update(self, app: App) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, app_id: int) -> bool: ...
+
+
+class AccessKeys(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, k: AccessKey) -> str | None: ...
+
+    @abc.abstractmethod
+    def get(self, key: str) -> AccessKey | None: ...
+
+    @abc.abstractmethod
+    def get_by_appid(self, appid: int) -> list[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[AccessKey]: ...
+
+    @abc.abstractmethod
+    def update(self, k: AccessKey) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> bool: ...
+
+
+class Channels(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, channel: Channel) -> int | None: ...
+
+    @abc.abstractmethod
+    def get(self, channel_id: int) -> Channel | None: ...
+
+    @abc.abstractmethod
+    def get_by_appid(self, appid: int) -> list[Channel]: ...
+
+    @abc.abstractmethod
+    def delete(self, channel_id: int) -> bool: ...
+
+
+class EngineInstances(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, i: EngineInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> EngineInstance | None: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> EngineInstance | None: ...
+
+    @abc.abstractmethod
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> list[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, i: EngineInstance) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+
+class EvaluationInstances(abc.ABC):
+    @abc.abstractmethod
+    def insert(self, i: EvaluationInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> EvaluationInstance | None: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_completed(self) -> list[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, i: EvaluationInstance) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+
+class Models(abc.ABC):
+    """Model blob store keyed by engine-instance id (Models.scala:33)."""
+
+    @abc.abstractmethod
+    def insert(self, instance_id: str, blob: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> bytes | None: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+
+# ---------------------------------------------------------------------------
+# Event DAOs
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class EventFilter:
+    """The find() filter algebra shared by both DAO shapes.
+
+    Mirrors LEvents.futureFind (LEvents.scala:188): time window
+    [start_time, until_time), entity, event-name list, target entity, limit
+    (None = all, reference used Some(-1) for all), reversed ordering.
+    """
+
+    start_time: datetime | None = None
+    until_time: datetime | None = None
+    entity_type: str | None = None
+    entity_id: str | None = None
+    event_names: tuple[str, ...] | None = None
+    target_entity_type: str | None = None  # "" matches None-valued target
+    target_entity_id: str | None = None
+    limit: int | None = None
+    reversed: bool = False
+
+    def matches(self, e: Event) -> bool:
+        if self.start_time is not None and e.event_time < self.start_time:
+            return False
+        if self.until_time is not None and e.event_time >= self.until_time:
+            return False
+        if self.entity_type is not None and e.entity_type != self.entity_type:
+            return False
+        if self.entity_id is not None and e.entity_id != self.entity_id:
+            return False
+        if self.event_names is not None and e.event not in self.event_names:
+            return False
+        if self.target_entity_type is not None:
+            want = self.target_entity_type or None
+            if e.target_entity_type != want:
+                return False
+        if self.target_entity_id is not None:
+            want = self.target_entity_id or None
+            if e.target_entity_id != want:
+                return False
+        return True
+
+
+class LEvents(abc.ABC):
+    """Row-at-a-time event CRUD + query, per (app_id, channel_id) namespace.
+
+    The reference exposes scala-future methods with blocking wrappers
+    (LEvents.scala:90-280); servers here wrap these sync methods in executors.
+    """
+
+    @abc.abstractmethod
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        """Create the namespace (table/keyspace) for an app/channel."""
+
+    @abc.abstractmethod
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        """Drop all events of an app/channel."""
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    @abc.abstractmethod
+    def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
+        """Insert one event, returning its id."""
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: int | None = None
+    ) -> list[str]:
+        return [self.insert(e, app_id, channel_id) for e in events]
+
+    @abc.abstractmethod
+    def get(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> Event | None: ...
+
+    @abc.abstractmethod
+    def delete(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> bool: ...
+
+    @abc.abstractmethod
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        filter: EventFilter | None = None,
+    ) -> Iterator[Event]: ...
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: int | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        required: Sequence[str] | None = None,
+    ) -> dict[str, PropertyMap]:
+        """Fold $set/$unset/$delete into per-entity property maps
+        (LEvents.futureAggregateProperties, LEvents.scala:215)."""
+        if not entity_type:
+            raise ValueError("aggregate_properties requires a non-empty entity_type")
+        events = self.find(
+            app_id,
+            channel_id,
+            EventFilter(
+                start_time=start_time,
+                until_time=until_time,
+                entity_type=entity_type,
+                event_names=("$set", "$unset", "$delete"),
+            ),
+        )
+        result = aggregate_properties(events)
+        if required:
+            req = set(required)
+            result = {
+                k: v for k, v in result.items() if req.issubset(v.keyset())
+            }
+        return result
+
+
+# ---------------------------------------------------------------------------
+# EventFrame: the columnar bulk-scan result (the PEvents role)
+# ---------------------------------------------------------------------------
+
+_EPOCH = datetime.fromtimestamp(0, tz=timezone.utc)
+
+
+def _to_ms(dt: datetime) -> int:
+    return int(dt.timestamp() * 1000)
+
+
+@dataclass
+class EventFrame:
+    """A columnar batch of events: numpy arrays ready for host staging.
+
+    This replaces the reference's ``RDD[Event]`` (PEvents.find, PEvents.scala:80).
+    String columns are object arrays (vocab-mapped to index arrays via BiMap
+    before device_put); ``event_time_ms`` is int64 epoch millis; ``properties``
+    is an object array of dicts (often empty).  Use ``property_column`` to pull
+    one numeric property into a float array without materializing Events.
+    """
+
+    event: np.ndarray  # object[str]
+    entity_type: np.ndarray  # object[str]
+    entity_id: np.ndarray  # object[str]
+    target_entity_type: np.ndarray  # object[str|None]
+    target_entity_id: np.ndarray  # object[str|None]
+    event_time_ms: np.ndarray  # int64
+    properties: np.ndarray  # object[dict]
+    # Identity/bookkeeping columns: kept so find() -> write() round-trips are
+    # lossless and idempotent (ids preserved). None when synthesized.
+    event_id: np.ndarray | None = None  # object[str|None]
+    tags: np.ndarray | None = None  # object[tuple[str,...]]
+    pr_id: np.ndarray | None = None  # object[str|None]
+    creation_time_ms: np.ndarray | None = None  # int64
+
+    def __len__(self) -> int:
+        return len(self.event)
+
+    @classmethod
+    def from_events(cls, events: Iterable[Event]) -> "EventFrame":
+        evs = list(events)
+        n = len(evs)
+
+        def col(f, dtype=object):
+            a = np.empty(n, dtype=dtype)
+            for i, e in enumerate(evs):
+                a[i] = f(e)
+            return a
+
+        return cls(
+            event=col(lambda e: e.event),
+            entity_type=col(lambda e: e.entity_type),
+            entity_id=col(lambda e: e.entity_id),
+            target_entity_type=col(lambda e: e.target_entity_type),
+            target_entity_id=col(lambda e: e.target_entity_id),
+            event_time_ms=np.fromiter(
+                (_to_ms(e.event_time) for e in evs), dtype=np.int64, count=n
+            ),
+            properties=col(lambda e: e.properties.fields),
+            event_id=col(lambda e: e.event_id),
+            tags=col(lambda e: e.tags),
+            pr_id=col(lambda e: e.pr_id),
+            creation_time_ms=np.fromiter(
+                (_to_ms(e.creation_time) for e in evs), dtype=np.int64, count=n
+            ),
+        )
+
+    def select(self, mask: np.ndarray) -> "EventFrame":
+        def opt(a):
+            return a[mask] if a is not None else None
+
+        return EventFrame(
+            event=self.event[mask],
+            entity_type=self.entity_type[mask],
+            entity_id=self.entity_id[mask],
+            target_entity_type=self.target_entity_type[mask],
+            target_entity_id=self.target_entity_id[mask],
+            event_time_ms=self.event_time_ms[mask],
+            properties=self.properties[mask],
+            event_id=opt(self.event_id),
+            tags=opt(self.tags),
+            pr_id=opt(self.pr_id),
+            creation_time_ms=opt(self.creation_time_ms),
+        )
+
+    def where_event(self, *names: str) -> "EventFrame":
+        return self.select(np.isin(self.event, list(names)))
+
+    def property_column(
+        self, name: str, default: float = np.nan, dtype=np.float32
+    ) -> np.ndarray:
+        out = np.full(len(self), default, dtype=dtype)
+        for i, p in enumerate(self.properties):
+            v = p.get(name) if p else None
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[i] = v
+        return out
+
+    def to_events(self) -> list[Event]:
+        out = []
+        for i in range(len(self)):
+            kwargs = {}
+            if self.event_id is not None:
+                kwargs["event_id"] = self.event_id[i]
+            if self.tags is not None and self.tags[i]:
+                kwargs["tags"] = tuple(self.tags[i])
+            if self.pr_id is not None:
+                kwargs["pr_id"] = self.pr_id[i]
+            if self.creation_time_ms is not None:
+                kwargs["creation_time"] = datetime.fromtimestamp(
+                    self.creation_time_ms[i] / 1000.0, tz=timezone.utc
+                )
+            out.append(
+                Event(
+                    event=self.event[i],
+                    entity_type=self.entity_type[i],
+                    entity_id=self.entity_id[i],
+                    target_entity_type=self.target_entity_type[i],
+                    target_entity_id=self.target_entity_id[i],
+                    properties=DataMap(self.properties[i] or {}),
+                    event_time=datetime.fromtimestamp(
+                        self.event_time_ms[i] / 1000.0, tz=timezone.utc
+                    ),
+                    **kwargs,
+                )
+            )
+        return out
+
+
+class PEvents(abc.ABC):
+    """Bulk columnar event access — the Spark-side DAO role, TPU-native.
+
+    ``find`` yields one EventFrame per shard so multi-host workers can each
+    scan an entity-hash range (the HBase row-key idea, HBEventsUtil.scala:83).
+    """
+
+    @abc.abstractmethod
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        filter: EventFilter | None = None,
+    ) -> EventFrame: ...
+
+    @abc.abstractmethod
+    def write(
+        self, frame: EventFrame, app_id: int, channel_id: int | None = None
+    ) -> None: ...
+
+    @abc.abstractmethod
+    def delete(
+        self, event_ids: Sequence[str], app_id: int, channel_id: int | None = None
+    ) -> None: ...
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: int | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        required: Sequence[str] | None = None,
+    ) -> dict[str, PropertyMap]:
+        if not entity_type:
+            raise ValueError("aggregate_properties requires a non-empty entity_type")
+        frame = self.find(
+            app_id,
+            channel_id,
+            EventFilter(
+                start_time=start_time,
+                until_time=until_time,
+                entity_type=entity_type,
+                event_names=("$set", "$unset", "$delete"),
+            ),
+        )
+        result = aggregate_properties(frame.to_events())
+        if required:
+            req = set(required)
+            result = {k: v for k, v in result.items() if req.issubset(v.keyset())}
+        return result

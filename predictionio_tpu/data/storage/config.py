@@ -1,0 +1,214 @@
+"""Env-var driven storage configuration and the process-wide storage runtime.
+
+Mirrors Storage.scala:158-223: sources from ``PIO_STORAGE_SOURCES_<NAME>_*``,
+repositories from ``PIO_STORAGE_REPOSITORIES_<REPO>_{NAME,SOURCE}``.
+Supported source TYPEs here: ``sqlite`` (events+metadata+models; the JDBC
+analog), ``localfs`` (models only).  With no configuration at all, everything
+lives under ``$PIO_HOME`` (default ``~/.predictionio_tpu``).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Mapping
+
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.localfs_models import LocalFSModels
+from predictionio_tpu.data.storage.sqlite_backend import (
+    SQLiteAccessKeys,
+    SQLiteApps,
+    SQLiteChannels,
+    SQLiteClient,
+    SQLiteEngineInstances,
+    SQLiteEvaluationInstances,
+    SQLiteLEvents,
+    SQLiteMetadata,
+    SQLiteModels,
+    SQLitePEvents,
+)
+
+REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
+
+_SOURCE_RE = re.compile(r"^PIO_STORAGE_SOURCES_([^_]+)_(.+)$")
+_REPO_RE = re.compile(r"^PIO_STORAGE_REPOSITORIES_([^_]+)_(NAME|SOURCE)$")
+
+
+class StorageError(Exception):
+    pass
+
+
+@dataclass
+class StorageConfig:
+    """Parsed storage topology: named sources + repo bindings."""
+
+    sources: dict[str, dict[str, str]] = field(default_factory=dict)
+    repositories: dict[str, dict[str, str]] = field(default_factory=dict)
+    home: Path = field(
+        default_factory=lambda: Path(
+            os.environ.get("PIO_HOME", str(Path.home() / ".predictionio_tpu"))
+        )
+    )
+
+    @classmethod
+    def from_env(cls, env: Mapping[str, str] | None = None) -> "StorageConfig":
+        env = dict(env if env is not None else os.environ)
+        cfg = cls()
+        if "PIO_HOME" in env:
+            cfg.home = Path(env["PIO_HOME"])
+        for key, value in env.items():
+            m = _SOURCE_RE.match(key)
+            if m:
+                cfg.sources.setdefault(m.group(1), {})[m.group(2)] = value
+                continue
+            m = _REPO_RE.match(key)
+            if m and m.group(1) in REPOSITORIES:
+                cfg.repositories.setdefault(m.group(1), {})[m.group(2)] = value
+        # Fill in the self-contained defaults for unbound repositories.
+        for repo in REPOSITORIES:
+            if "SOURCE" not in cfg.repositories.get(repo, {}):
+                cfg.repositories.setdefault(repo, {})["SOURCE"] = "PIO_DEFAULT"
+        if any(
+            r["SOURCE"] == "PIO_DEFAULT" for r in cfg.repositories.values()
+        ) and "PIO_DEFAULT" not in cfg.sources:
+            cfg.sources["PIO_DEFAULT"] = {
+                "TYPE": "sqlite",
+                "PATH": str(cfg.home / "pio.sqlite"),
+            }
+        return cfg
+
+    def source_for(self, repo: str) -> tuple[str, dict[str, str]]:
+        binding = self.repositories.get(repo, {})
+        name = binding.get("SOURCE", "PIO_DEFAULT")
+        if name not in self.sources:
+            raise StorageError(
+                f"repository {repo} is bound to undefined source {name!r}; "
+                f"defined sources: {sorted(self.sources)}"
+            )
+        return name, self.sources[name]
+
+
+class StorageRuntime:
+    """Lazily-instantiated DAO singletons resolved through the config.
+
+    The reference's Storage object caches clients and DAOs per source
+    (Storage.scala:239-293); we do the same keyed by source name.
+    """
+
+    def __init__(self, config: StorageConfig | None = None):
+        self.config = config or StorageConfig.from_env()
+        self._clients: dict[str, Any] = {}
+        self._lock = threading.RLock()
+
+    def _sqlite_client(self, name: str, props: dict[str, str]) -> SQLiteClient:
+        with self._lock:
+            if name not in self._clients:
+                path = props.get("PATH") or props.get("URL") or ":memory:"
+                client = SQLiteClient(path)
+                SQLiteMetadata(client)
+                self._clients[name] = client
+            return self._clients[name]
+
+    def _meta_client(self) -> SQLiteClient:
+        name, props = self.config.source_for("METADATA")
+        if props.get("TYPE", "sqlite") != "sqlite":
+            raise StorageError(f"METADATA requires a sqlite source, got {props}")
+        return self._sqlite_client(name, props)
+
+    def _event_client(self) -> SQLiteClient:
+        name, props = self.config.source_for("EVENTDATA")
+        if props.get("TYPE", "sqlite") != "sqlite":
+            raise StorageError(f"EVENTDATA requires a sqlite source, got {props}")
+        return self._sqlite_client(name, props)
+
+    # -- metadata DAOs -------------------------------------------------------
+    def apps(self) -> base.Apps:
+        return SQLiteApps(self._meta_client())
+
+    def access_keys(self) -> base.AccessKeys:
+        return SQLiteAccessKeys(self._meta_client())
+
+    def channels(self) -> base.Channels:
+        return SQLiteChannels(self._meta_client())
+
+    def engine_instances(self) -> base.EngineInstances:
+        return SQLiteEngineInstances(self._meta_client())
+
+    def evaluation_instances(self) -> base.EvaluationInstances:
+        return SQLiteEvaluationInstances(self._meta_client())
+
+    def models(self) -> base.Models:
+        name, props = self.config.source_for("MODELDATA")
+        typ = props.get("TYPE", "sqlite")
+        if typ == "localfs":
+            return LocalFSModels(props.get("PATH", str(self.config.home / "models")))
+        if typ == "sqlite":
+            return SQLiteModels(self._sqlite_client(name, props))
+        raise StorageError(f"unsupported MODELDATA source type {typ!r}")
+
+    # -- event DAOs (cached: the DAO keeps a known-tables set so the serving
+    # hot path skips per-call DDL) ------------------------------------------
+    def l_events(self) -> base.LEvents:
+        with self._lock:
+            if "__levents__" not in self._clients:
+                self._clients["__levents__"] = SQLiteLEvents(self._event_client())
+            return self._clients["__levents__"]
+
+    def p_events(self) -> base.PEvents:
+        with self._lock:
+            if "__pevents__" not in self._clients:
+                self._clients["__pevents__"] = SQLitePEvents(
+                    self._event_client(), self.l_events()
+                )
+            return self._clients["__pevents__"]
+
+    # -- ops -----------------------------------------------------------------
+    def verify_all_data_objects(self) -> dict[str, bool]:
+        """Connectivity check per repository (the `pio status` probe,
+        Storage.verifyAllDataObjects)."""
+        out = {}
+        for repo, probe in (
+            ("METADATA", lambda: self.apps().get_all()),
+            ("EVENTDATA", lambda: self.l_events().init(0) and self.l_events().remove(0)),
+            ("MODELDATA", lambda: self.models().get("__probe__")),
+        ):
+            try:
+                probe()
+                out[repo] = True
+            except Exception:
+                out[repo] = False
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._clients.values():
+                try:
+                    c.close()
+                except Exception:
+                    pass
+            self._clients.clear()
+
+
+_runtime: StorageRuntime | None = None
+_runtime_lock = threading.Lock()
+
+
+def get_storage() -> StorageRuntime:
+    global _runtime
+    with _runtime_lock:
+        if _runtime is None:
+            _runtime = StorageRuntime()
+        return _runtime
+
+
+def reset_storage(config: StorageConfig | None = None) -> StorageRuntime:
+    """Swap the process-wide runtime (tests point it at temp dirs)."""
+    global _runtime
+    with _runtime_lock:
+        if _runtime is not None:
+            _runtime.close()
+        _runtime = StorageRuntime(config)
+        return _runtime

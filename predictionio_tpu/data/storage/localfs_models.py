@@ -1,0 +1,34 @@
+"""Local-filesystem model blob store (reference storage/localfs/LocalFSModels.scala:32)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from predictionio_tpu.data.storage import base
+
+
+class LocalFSModels(base.Models):
+    def __init__(self, path: str | Path):
+        self.root = Path(path)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _file(self, instance_id: str) -> Path:
+        # instance ids are hex/uuid strings; guard against path traversal anyway
+        safe = instance_id.replace("/", "_").replace("..", "_")
+        return self.root / f"pio_model_{safe}.bin"
+
+    def insert(self, instance_id: str, blob: bytes) -> None:
+        tmp = self._file(instance_id).with_suffix(".tmp")
+        tmp.write_bytes(blob)
+        tmp.replace(self._file(instance_id))
+
+    def get(self, instance_id: str) -> bytes | None:
+        f = self._file(instance_id)
+        return f.read_bytes() if f.exists() else None
+
+    def delete(self, instance_id: str) -> bool:
+        f = self._file(instance_id)
+        if f.exists():
+            f.unlink()
+            return True
+        return False

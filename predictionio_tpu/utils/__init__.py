@@ -1,0 +1,3 @@
+from predictionio_tpu.utils.registry import Registry
+
+__all__ = ["Registry"]

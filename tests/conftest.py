@@ -1,0 +1,33 @@
+"""Test harness configuration.
+
+Distributed logic is tested the way the reference tests Spark code with
+``local[*]`` (SURVEY.md §4): a virtual 8-device CPU mesh via
+``--xla_force_host_platform_device_count=8``.  Must be set before jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+
+@pytest.fixture()
+def storage(tmp_path):
+    """A fresh isolated storage runtime rooted in a temp dir."""
+    from predictionio_tpu.data.storage.config import (
+        StorageConfig,
+        reset_storage,
+    )
+
+    cfg = StorageConfig.from_env(
+        {"PIO_HOME": str(tmp_path / "pio_home")}
+    )
+    rt = reset_storage(cfg)
+    yield rt
+    rt.close()

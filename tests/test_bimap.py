@@ -1,0 +1,31 @@
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import BiMap
+
+
+def test_from_keys_dedup_order():
+    bm = BiMap.from_keys(["b", "a", "b", "c"])
+    assert len(bm) == 3
+    assert bm["b"] == 0 and bm["a"] == 1 and bm["c"] == 2
+    assert bm.inverse(1) == "a"
+
+
+def test_vectorized_lookup():
+    bm = BiMap.string_int(["u1", "u2", "u3"])
+    arr = bm.to_index_array(["u3", "zz", "u1"])
+    assert arr.tolist() == [2, -1, 0]
+    assert arr.dtype == np.int64
+
+
+def test_state_roundtrip():
+    bm = BiMap.from_keys(["x", "y"])
+    bm2 = BiMap.from_state(bm.to_state())
+    assert bm2 == bm
+
+
+def test_invalid_indices_rejected():
+    with pytest.raises(ValueError):
+        BiMap({"a": 0, "b": 2})
+    with pytest.raises(ValueError):
+        BiMap({"a": 0, "b": 0})

@@ -1,0 +1,211 @@
+"""Event / DataMap / aggregation semantics (reference: DataMapSpec, LEventAggregatorSpec)."""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from predictionio_tpu.data import (
+    DataMap,
+    DataMapError,
+    Event,
+    EventValidationError,
+    PropertyMap,
+    aggregate_properties,
+    aggregate_properties_single,
+    validate_event,
+)
+from predictionio_tpu.data.datamap import format_event_time, parse_event_time
+
+
+def t(i: int) -> datetime:
+    return datetime(2026, 1, 1, 0, 0, i, tzinfo=timezone.utc)
+
+
+class TestEventValidation:
+    def ok(self, **kw):
+        defaults = dict(event="view", entity_type="user", entity_id="u1")
+        defaults.update(kw)
+        validate_event(Event(**defaults))
+
+    def bad(self, **kw):
+        with pytest.raises(EventValidationError):
+            self.ok(**kw)
+
+    def test_plain_event_ok(self):
+        self.ok()
+
+    def test_special_events_ok(self):
+        self.ok(event="$set", properties={"a": 1})
+        self.ok(event="$unset", properties={"a": 1})
+        self.ok(event="$delete")
+
+    def test_empty_fields_rejected(self):
+        self.bad(event="")
+        self.bad(entity_type="")
+        self.bad(entity_id="")
+
+    def test_target_must_be_paired(self):
+        self.bad(target_entity_type="item")
+        self.bad(target_entity_id="i1")
+        self.ok(target_entity_type="item", target_entity_id="i1")
+
+    def test_unset_requires_properties(self):
+        self.bad(event="$unset")
+
+    def test_reserved_prefixes(self):
+        self.bad(event="$foo")
+        self.bad(event="pio_custom")
+        self.bad(entity_type="pio_user")
+        self.ok(entity_type="pio_pr")  # built-in
+        self.bad(target_entity_type="pio_x", target_entity_id="1")
+        self.bad(properties={"pio_score": 1})
+
+    def test_special_event_cannot_target(self):
+        with pytest.raises(EventValidationError):
+            validate_event(
+                Event(
+                    event="$set",
+                    entity_type="user",
+                    entity_id="u1",
+                    target_entity_type="item",
+                    target_entity_id="i1",
+                    properties=DataMap({"a": 1}),
+                )
+            )
+
+    def test_api_roundtrip(self):
+        e = Event(
+            event="rate",
+            entity_type="user",
+            entity_id="u1",
+            target_entity_type="item",
+            target_entity_id="i9",
+            properties=DataMap({"rating": 4.5}),
+            event_time=t(30),
+            tags=("a", "b"),
+            pr_id="pr-1",
+        ).with_id("ev42")
+        d = e.to_api_dict()
+        e2 = Event.from_api_dict(d)
+        assert e2.event_id == "ev42"
+        assert e2.properties.get("rating", float) == 4.5
+        assert e2.event_time == t(30)
+        assert e2.tags == ("a", "b")
+
+    def test_from_api_dict_rejects_junk(self):
+        with pytest.raises(EventValidationError):
+            Event.from_api_dict({"event": "view"})
+        with pytest.raises(EventValidationError):
+            Event.from_api_dict(
+                {"event": "view", "entityType": "u", "entityId": "1",
+                 "eventTime": "not-a-time"}
+            )
+
+
+class TestDataMap:
+    def test_typed_get(self):
+        dm = DataMap({"a": 1, "b": "x", "c": [1.0, 2.5], "d": True, "n": None})
+        assert dm.get("a", int) == 1
+        assert dm.get("a", float) == 1.0
+        assert dm.get("b", str) == "x"
+        assert dm.get("c", list[float]) == [1.0, 2.5]
+        assert dm.get("d", bool) is True
+        with pytest.raises(DataMapError):
+            dm.get("n", int)  # null required field
+        with pytest.raises(DataMapError):
+            dm.get("missing", int)
+        with pytest.raises(DataMapError):
+            dm.get("b", int)  # type mismatch
+
+    def test_opt_and_default(self):
+        dm = DataMap({"a": 2})
+        assert dm.get_opt("a", int) == 2
+        assert dm.get_opt("z", int) is None
+        assert dm.get_or_else("z", 7, int) == 7
+
+    def test_merge_and_remove(self):
+        a = DataMap({"x": 1, "y": 2})
+        b = DataMap({"y": 3, "z": 4})
+        assert (a + b).fields == {"x": 1, "y": 3, "z": 4}
+        assert (a - ["x"]).fields == {"y": 2}
+
+    def test_extract_dataclass(self):
+        from dataclasses import dataclass
+
+        @dataclass
+        class P:
+            name: str
+            score: float
+            tags: list
+
+        p = DataMap({"name": "n", "score": 3, "tags": ["a"]}).extract(P)
+        assert p == P("n", 3.0, ["a"])
+
+    def test_time_parse_formats(self):
+        dt = parse_event_time("2026-01-02T03:04:05.678Z")
+        assert dt == datetime(2026, 1, 2, 3, 4, 5, 678000, tzinfo=timezone.utc)
+        assert format_event_time(dt) == "2026-01-02T03:04:05.678Z"
+        assert parse_event_time(dt.timestamp() * 1000) == dt
+
+
+def set_ev(eid, props, i):
+    return Event(event="$set", entity_type="user", entity_id=eid,
+                 properties=DataMap(props), event_time=t(i))
+
+
+def unset_ev(eid, keys, i):
+    return Event(event="$unset", entity_type="user", entity_id=eid,
+                 properties=DataMap({k: None for k in keys}), event_time=t(i))
+
+
+def del_ev(eid, i):
+    return Event(event="$delete", entity_type="user", entity_id=eid, event_time=t(i))
+
+
+class TestAggregation:
+    def test_set_merge_latest_wins(self):
+        pm = aggregate_properties_single(
+            [set_ev("u", {"a": 1, "b": 2}, 1), set_ev("u", {"b": 9, "c": 3}, 2)]
+        )
+        assert pm is not None
+        assert pm.fields == {"a": 1, "b": 9, "c": 3}
+        assert pm.first_updated == t(1)
+        assert pm.last_updated == t(2)
+
+    def test_out_of_order_events_sorted_by_time(self):
+        pm = aggregate_properties_single(
+            [set_ev("u", {"b": 9}, 2), set_ev("u", {"a": 1, "b": 2}, 1)]
+        )
+        assert pm.fields == {"a": 1, "b": 9}
+
+    def test_unset_removes(self):
+        pm = aggregate_properties_single(
+            [set_ev("u", {"a": 1, "b": 2}, 1), unset_ev("u", ["a"], 2)]
+        )
+        assert pm.fields == {"b": 2}
+
+    def test_delete_drops_entity(self):
+        assert aggregate_properties_single(
+            [set_ev("u", {"a": 1}, 1), del_ev("u", 2)]
+        ) is None
+
+    def test_set_after_delete_recreates(self):
+        pm = aggregate_properties_single(
+            [set_ev("u", {"a": 1}, 1), del_ev("u", 2), set_ev("u", {"z": 5}, 3)]
+        )
+        assert pm.fields == {"z": 5}
+        assert pm.first_updated == t(3)
+
+    def test_other_events_ignored(self):
+        view = Event(event="view", entity_type="user", entity_id="u",
+                     event_time=t(5))
+        pm = aggregate_properties_single([set_ev("u", {"a": 1}, 1), view])
+        assert pm.fields == {"a": 1}
+        assert pm.last_updated == t(1)
+
+    def test_grouped(self):
+        out = aggregate_properties(
+            [set_ev("u1", {"a": 1}, 1), set_ev("u2", {"b": 2}, 1), del_ev("u2", 2)]
+        )
+        assert set(out) == {"u1"}
+        assert isinstance(out["u1"], PropertyMap)

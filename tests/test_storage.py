@@ -1,0 +1,221 @@
+"""Storage SPI tests: sqlite events DAO, metadata DAOs, store facades."""
+
+from datetime import datetime, timezone
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EventFilter,
+)
+from predictionio_tpu.data.store import AppNotFoundError, LEventStore, PEventStore
+
+
+def t(i):
+    return datetime(2026, 1, 1, 0, 0, i, tzinfo=timezone.utc)
+
+
+def mk(event, eid, i, target=None, props=None):
+    return Event(
+        event=event,
+        entity_type="user",
+        entity_id=eid,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=DataMap(props or {}),
+        event_time=t(i),
+    )
+
+
+class TestLEvents:
+    def test_crud(self, storage):
+        le = storage.l_events()
+        le.init(1)
+        eid = le.insert(mk("view", "u1", 1, target="i1"), 1)
+        got = le.get(eid, 1)
+        assert got is not None and got.event == "view" and got.entity_id == "u1"
+        assert le.delete(eid, 1)
+        assert le.get(eid, 1) is None
+        assert not le.delete(eid, 1)
+
+    def test_find_filters(self, storage):
+        le = storage.l_events()
+        le.init(1)
+        le.insert_batch(
+            [
+                mk("view", "u1", 1, target="i1"),
+                mk("buy", "u1", 2, target="i2"),
+                mk("view", "u2", 3, target="i1"),
+                mk("$set", "u1", 4, props={"a": 1}),
+            ],
+            1,
+        )
+        assert len(list(le.find(1))) == 4
+        assert len(list(le.find(1, filter=EventFilter(entity_id="u1")))) == 3
+        assert len(list(le.find(1, filter=EventFilter(event_names=("view",))))) == 2
+        assert (
+            len(list(le.find(1, filter=EventFilter(start_time=t(2), until_time=t(4)))))
+            == 2
+        )
+        assert (
+            len(list(le.find(1, filter=EventFilter(target_entity_id="i1")))) == 2
+        )
+        # "" matches events with NO target entity
+        assert (
+            len(list(le.find(1, filter=EventFilter(target_entity_type="")))) == 1
+        )
+        lim = list(le.find(1, filter=EventFilter(limit=2, reversed=True)))
+        assert [e.event_time for e in lim] == [t(4), t(3)]
+
+    def test_channels_isolated(self, storage):
+        le = storage.l_events()
+        le.init(1)
+        le.init(1, 7)
+        le.insert(mk("view", "u1", 1), 1)
+        le.insert(mk("buy", "u9", 1), 1, 7)
+        assert [e.event for e in le.find(1)] == ["view"]
+        assert [e.event for e in le.find(1, 7)] == ["buy"]
+        le.remove(1, 7)
+        assert list(le.find(1, 7)) == []  # re-inits empty
+
+    def test_aggregate_properties(self, storage):
+        le = storage.l_events()
+        le.init(1)
+        le.insert(mk("$set", "u1", 1, props={"a": 1, "g": "m"}), 1)
+        le.insert(mk("$set", "u1", 2, props={"a": 2}), 1)
+        le.insert(mk("$set", "u2", 1, props={"g": "f"}), 1)
+        out = le.aggregate_properties(1, entity_type="user")
+        assert out["u1"].fields == {"a": 2, "g": "m"}
+        req = le.aggregate_properties(1, entity_type="user", required=["a"])
+        assert set(req) == {"u1"}
+        with pytest.raises(ValueError):
+            le.aggregate_properties(1, entity_type="")
+
+
+class TestPEvents:
+    def test_columnar_scan(self, storage):
+        le, pe = storage.l_events(), storage.p_events()
+        le.init(1)
+        le.insert_batch(
+            [
+                mk("rate", "u1", 1, target="i1", props={"rating": 4.0}),
+                mk("rate", "u2", 2, target="i2", props={"rating": 2.5}),
+                mk("view", "u1", 3, target="i3"),
+            ],
+            1,
+        )
+        frame = pe.find(1)
+        assert len(frame) == 3
+        rated = frame.where_event("rate")
+        assert len(rated) == 2
+        np.testing.assert_allclose(
+            rated.property_column("rating"), [4.0, 2.5]
+        )
+        assert rated.entity_id.tolist() == ["u1", "u2"]
+        assert rated.target_entity_id.tolist() == ["i1", "i2"]
+
+    def test_write_roundtrip_idempotent(self, storage):
+        le, pe = storage.l_events(), storage.p_events()
+        le.init(1)
+        le.insert_batch(
+            [mk("rate", "u1", 1, target="i1", props={"rating": 3.0})], 1
+        )
+        frame = pe.find(1)
+        pe.write(frame, 2)
+        pe.write(frame, 2)  # ids preserved -> INSERT OR REPLACE dedupes
+        assert len(pe.find(2)) == 1
+        assert pe.find(2).event_id.tolist() == frame.event_id.tolist()
+
+    def test_columnar_limit_and_order(self, storage):
+        le, pe = storage.l_events(), storage.p_events()
+        le.init(1)
+        le.insert_batch([mk("view", f"u{i}", i) for i in range(5)], 1)
+        f = pe.find(1, filter=EventFilter(limit=2, reversed=True))
+        assert f.event_time_ms.tolist() == [t(4).timestamp() * 1000,
+                                            t(3).timestamp() * 1000]
+
+
+class TestMetadata:
+    def test_apps(self, storage):
+        apps = storage.apps()
+        app_id = apps.insert(App(id=0, name="myapp", description="d"))
+        assert app_id is not None
+        assert apps.insert(App(id=0, name="myapp")) is None  # dup name
+        assert apps.get(app_id).name == "myapp"
+        assert apps.get_by_name("myapp").id == app_id
+        assert len(apps.get_all()) == 1
+        assert apps.delete(app_id)
+        assert apps.get(app_id) is None
+
+    def test_access_keys(self, storage):
+        ak = storage.access_keys()
+        key = ak.insert(AccessKey(key="", appid=3, events=("view", "buy")))
+        assert key
+        got = ak.get(key)
+        assert got.appid == 3 and got.events == ("view", "buy")
+        assert ak.get_by_appid(3)[0].key == key
+        assert ak.delete(key)
+
+    def test_channels(self, storage):
+        ch = storage.channels()
+        cid = ch.insert(Channel(id=0, name="live", appid=1))
+        assert ch.get(cid).name == "live"
+        assert ch.get_by_appid(1)[0].id == cid
+        with pytest.raises(ValueError):
+            Channel(id=0, name="bad name!", appid=1)
+        with pytest.raises(ValueError):
+            Channel(id=0, name="x" * 17, appid=1)
+
+    def test_engine_instances(self, storage):
+        ei = storage.engine_instances()
+        inst = EngineInstance(
+            id="abc",
+            status="INIT",
+            start_time=t(1),
+            end_time=t(1),
+            engine_id="e1",
+            engine_version="v1",
+            engine_variant="default",
+            engine_factory="pkg:Factory",
+        )
+        ei.insert(inst)
+        assert ei.get("abc").status == "INIT"
+        ei.update(inst.completed())
+        latest = ei.get_latest_completed("e1", "v1", "default")
+        assert latest is not None and latest.status == "COMPLETED"
+
+    def test_models_blob(self, storage):
+        m = storage.models()
+        m.insert("i1", b"\x00\x01binary")
+        assert m.get("i1") == b"\x00\x01binary"
+        assert m.delete("i1")
+        assert m.get("i1") is None
+
+
+class TestFacades:
+    def test_store_facades(self, storage):
+        app_id = storage.apps().insert(App(id=0, name="shop"))
+        le = storage.l_events()
+        le.init(app_id)
+        le.insert(mk("rate", "u1", 1, target="i1", props={"rating": 5.0}), app_id)
+        frame = PEventStore(storage).find("shop", event_names=["rate"])
+        assert len(frame) == 1
+        evs = list(
+            LEventStore(storage).find_by_entity("shop", "user", "u1", limit=10)
+        )
+        assert len(evs) == 1
+        with pytest.raises(AppNotFoundError):
+            PEventStore(storage).find("nope")
+
+    def test_localfs_models(self, tmp_path):
+        from predictionio_tpu.data.storage.localfs_models import LocalFSModels
+
+        m = LocalFSModels(tmp_path / "models")
+        m.insert("xyz", b"blob")
+        assert m.get("xyz") == b"blob"
+        assert m.delete("xyz") and not m.delete("xyz")
