@@ -1,0 +1,63 @@
+"""DASE controller API: DataSource -> Preparator -> Algorithm -> Serving.
+
+Reference layer 6: core/src/main/scala/org/apache/predictionio/{core,controller}.
+"""
+
+from predictionio_tpu.core.base import (
+    Algorithm,
+    DataSource,
+    EngineContext,
+    IdentityPreparator,
+    L,
+    P,
+    P2L,
+    Preparator,
+    SanityCheckError,
+    Serving,
+    FirstServing,
+    AverageServing,
+)
+from predictionio_tpu.core.engine import (
+    Engine,
+    EngineFactory,
+    EngineParams,
+    SimpleEngine,
+)
+from predictionio_tpu.core.metric import (
+    AverageMetric,
+    Metric,
+    OptionAverageMetric,
+    OptionStdevMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from predictionio_tpu.utils.params import EmptyParams, Params
+
+__all__ = [
+    "Algorithm",
+    "AverageMetric",
+    "AverageServing",
+    "DataSource",
+    "EmptyParams",
+    "Engine",
+    "EngineContext",
+    "EngineFactory",
+    "EngineParams",
+    "FirstServing",
+    "IdentityPreparator",
+    "L",
+    "Metric",
+    "OptionAverageMetric",
+    "OptionStdevMetric",
+    "P",
+    "P2L",
+    "Params",
+    "Preparator",
+    "SanityCheckError",
+    "Serving",
+    "SimpleEngine",
+    "StdevMetric",
+    "SumMetric",
+    "ZeroMetric",
+]

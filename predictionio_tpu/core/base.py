@@ -1,0 +1,194 @@
+"""DASE component protocols and the engine context.
+
+The typed 6-tuple Engine[TD, EI, PD, Q, P, A] of the reference
+(controller/Engine.scala:82) maps to duck-typed Python components with the
+same four stages:
+
+  DataSource.read_training(ctx) -> TD
+  Preparator.prepare(ctx, td) -> PD
+  Algorithm.train(ctx, pd) -> M ; .predict(m, q) -> P
+  Serving.supplement(q) / .serve(q, [P]) -> P
+
+Algorithm *flavors* carry the reference's P / P2L / L distinction
+(controller/{PAlgorithm,P2LAlgorithm,LAlgorithm}.scala) re-expressed for a
+device mesh: P trains AND serves a mesh-sharded model, P2L trains sharded but
+serves a replicated/local model, L is single-device end-to-end.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Callable, Generic, Sequence, TypeVar
+
+import jax
+import numpy as np
+
+from predictionio_tpu.data.store import LEventStore, PEventStore
+from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
+from predictionio_tpu.parallel.mesh import MeshConfig, make_mesh
+
+TD = TypeVar("TD")  # training data
+EI = TypeVar("EI")  # evaluation info
+PD = TypeVar("PD")  # prepared data
+Q = TypeVar("Q")  # query
+PR = TypeVar("PR")  # predicted result
+A = TypeVar("A")  # actual result
+M = TypeVar("M")  # model
+
+#: Algorithm flavors (distribution strategy of model/train),
+#: named for parity with the reference's PAlgorithm/P2LAlgorithm/LAlgorithm.
+P, P2L, L = "P", "P2L", "L"  # noqa: E741
+
+
+class SanityCheckError(AssertionError):
+    """A data stage failed its sanity check (controller/SanityCheck.scala:27)."""
+
+
+@dataclass
+class EngineContext:
+    """What the reference threads as SparkContext, re-imagined for TPU.
+
+    Carries the device mesh (None => build default lazily), the storage
+    runtime, a base PRNG seed, and workflow flags.  Passed to every DASE
+    stage; components use ``ctx.p_event_store`` for bulk reads and
+    ``ctx.mesh`` for sharded compute.
+    """
+
+    mesh_config: MeshConfig = field(default_factory=MeshConfig)
+    storage: StorageRuntime | None = None
+    seed: int = 0
+    mode: str = "train"  # train | eval | serving | batchpredict
+    _mesh: Any = None
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = make_mesh(self.mesh_config)
+        return self._mesh
+
+    def rng(self, salt: int = 0) -> jax.Array:
+        return jax.random.PRNGKey((self.seed * 0x9E3779B1 + salt) & 0xFFFFFFFF)
+
+    @property
+    def storage_runtime(self) -> StorageRuntime:
+        return self.storage or get_storage()
+
+    @property
+    def p_event_store(self) -> PEventStore:
+        return PEventStore(self.storage_runtime)
+
+    @property
+    def l_event_store(self) -> LEventStore:
+        return LEventStore(self.storage_runtime)
+
+
+def run_sanity_check(obj: Any) -> None:
+    """Invoke obj.sanity_check() when present (train pipeline hook)."""
+    check = getattr(obj, "sanity_check", None)
+    if callable(check):
+        check()
+
+
+class DataSource(abc.ABC, Generic[TD, EI, Q, A]):
+    """Reads training and evaluation data (core/BaseDataSource.scala:34)."""
+
+    @abc.abstractmethod
+    def read_training(self, ctx: EngineContext) -> TD: ...
+
+    def read_eval(
+        self, ctx: EngineContext
+    ) -> list[tuple[TD, EI, list[tuple[Q, A]]]]:
+        """Per-fold (trainingData, evalInfo, [(query, actual)]) sets."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement read_eval; "
+            "evaluation is unavailable for this engine"
+        )
+
+
+class Preparator(abc.ABC, Generic[TD, PD]):
+    """Transforms training data for the algorithms (core/BasePreparator.scala:33)."""
+
+    @abc.abstractmethod
+    def prepare(self, ctx: EngineContext, td: TD) -> PD: ...
+
+
+class IdentityPreparator(Preparator):
+    """Pass-through (controller/IdentityPreparator.scala:32)."""
+
+    def __init__(self, params: Any = None):
+        pass
+
+    def prepare(self, ctx: EngineContext, td):
+        return td
+
+
+class Algorithm(abc.ABC, Generic[PD, M, Q, PR]):
+    """Train a model and answer queries (core/BaseAlgorithm.scala:58).
+
+    ``flavor`` ∈ {"P", "P2L", "L"}:
+      P   — model stays mesh-sharded; serving queries the sharded params.
+      P2L — train on the mesh, then materialize a local/replicated model
+            for serving (the collect-to-driver analog is device_get/replicate).
+      L   — single-device train and serve.
+    """
+
+    flavor: str = P2L
+
+    @abc.abstractmethod
+    def train(self, ctx: EngineContext, pd: PD) -> M: ...
+
+    @abc.abstractmethod
+    def predict(self, model: M, query: Q) -> PR: ...
+
+    def batch_predict(self, model: M, queries: Sequence[tuple[int, Q]]) -> list[tuple[int, PR]]:
+        """Bulk predict for evaluation: [(index, query)] -> [(index, prediction)].
+
+        Default mirrors P2LAlgorithm.batchPredict (qs.mapValues(predict));
+        algorithms override with a vectorized jit path where shapes allow.
+        """
+        return [(i, self.predict(model, q)) for i, q in queries]
+
+    # -- persistence hooks (controller/PersistentModel.scala) ---------------
+    def make_persistent_model(self, ctx: EngineContext, model: M) -> Any:
+        """Convert the trained model into its checkpointable form.
+
+        Mirrors makeSerializableModels (BaseAlgorithm.scala:111 /
+        Engine.makeSerializableModels:284): sharded device arrays are pulled
+        to host numpy by the default persistence layer; override to customize.
+        """
+        return model
+
+    def load_persistent_model(self, ctx: EngineContext, data: Any) -> M:
+        """Inverse of make_persistent_model at deploy time."""
+        return data
+
+
+class Serving(abc.ABC, Generic[Q, PR]):
+    """Combine per-algorithm predictions into one result (core/BaseServing.scala)."""
+
+    def supplement(self, query: Q) -> Q:
+        return query
+
+    @abc.abstractmethod
+    def serve(self, query: Q, predictions: Sequence[PR]) -> PR: ...
+
+
+class FirstServing(Serving):
+    """Serve the first algorithm's prediction (controller/LFirstServing.scala:28)."""
+
+    def __init__(self, params: Any = None):
+        pass
+
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+class AverageServing(Serving):
+    """Average numeric predictions (controller/LAverageServing.scala:28)."""
+
+    def __init__(self, params: Any = None):
+        pass
+
+    def serve(self, query, predictions):
+        return sum(predictions) / len(predictions)

@@ -1,0 +1,44 @@
+"""Model serialization: pytree-aware blobs for the Models store.
+
+The reference Kryo-serializes the whole Seq[model] into the MODELDATA
+repository (workflow/CoreWorkflow.scala:76-81).  Here models are arbitrary
+Python objects whose array leaves may be jax device arrays: ``serialize``
+pulls every jax array to host numpy (device_get) and pickles; ``deserialize``
+restores numpy leaves (algorithms re-device_put / re-shard in
+``load_persistent_model``).  Checkpoint contents therefore never depend on
+device topology.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _to_host(obj: Any) -> Any:
+    """Map jax arrays to numpy throughout an arbitrary pytree-ish object."""
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.device_get(x)) if isinstance(x, jax.Array) else x,
+        obj,
+        is_leaf=lambda x: isinstance(x, jax.Array),
+    )
+
+
+class _NumpyPickler(pickle.Pickler):
+    pass
+
+
+def serialize_models(models: list[Any]) -> bytes:
+    buf = io.BytesIO()
+    _NumpyPickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(
+        [_to_host(m) for m in models]
+    )
+    return buf.getvalue()
+
+
+def deserialize_models(blob: bytes) -> list[Any]:
+    return pickle.loads(blob)

@@ -1,0 +1,158 @@
+"""Train and evaluation workflows.
+
+Mirrors workflow/CoreWorkflow.scala: ``run_train`` (runTrain:45) executes the
+engine's train pipeline, checkpoints the models into the MODELDATA store, and
+records an EngineInstance row (status INIT -> COMPLETED/FAILED);
+``run_evaluation`` (runEvaluation:104 + EvaluationWorkflow.scala:36) sweeps an
+engine-params list through batch evaluation, scores with the evaluator, and
+records an EvaluationInstance.  There is no spark-submit process hop — the
+workflow runs in-process on the TPU VM.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import traceback
+import uuid
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from typing import Any, Callable, Sequence
+
+from predictionio_tpu.core.base import EngineContext
+from predictionio_tpu.core.engine import Engine, EngineParams
+from predictionio_tpu.core.persistence import serialize_models
+from predictionio_tpu.data.storage.base import EngineInstance, EvaluationInstance
+from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
+
+log = logging.getLogger("predictionio_tpu.workflow")
+
+
+@dataclass
+class WorkflowParams:
+    """Workflow flags (workflow/WorkflowParams.scala:32)."""
+
+    batch: str = ""
+    verbose: int = 2
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False
+
+
+def _now() -> datetime:
+    return datetime.now(tz=timezone.utc)
+
+
+def run_train(
+    engine: Engine,
+    engine_params: EngineParams,
+    ctx: EngineContext | None = None,
+    workflow_params: WorkflowParams | None = None,
+    engine_id: str = "default",
+    engine_version: str = "default",
+    engine_variant: str = "default",
+    engine_factory: str = "",
+    storage: StorageRuntime | None = None,
+) -> EngineInstance | None:
+    """Train, persist models, and record the engine instance.
+
+    Returns the COMPLETED EngineInstance (the deploy handle), or None when
+    stopped early by stop_after_read/stop_after_prepare (no instance row is
+    kept).  On failure the row is left in status FAILED and the exception
+    re-raised.
+    """
+    storage = storage or get_storage()
+    ctx = ctx or EngineContext(storage=storage)
+    wp = workflow_params or WorkflowParams()
+    instances = storage.engine_instances()
+    instance = EngineInstance(
+        id=uuid.uuid4().hex,
+        status="INIT",
+        start_time=_now(),
+        end_time=_now(),
+        engine_id=engine_id,
+        engine_version=engine_version,
+        engine_variant=engine_variant,
+        engine_factory=engine_factory,
+        batch=wp.batch,
+        mesh_conf=ctx.mesh_config.to_dict(),
+        **engine_params.to_json_fields(),
+    )
+    instances.insert(instance)
+    try:
+        algos, models = engine.train_full(
+            ctx,
+            engine_params,
+            skip_sanity_check=wp.skip_sanity_check,
+            stop_after_read=wp.stop_after_read,
+            stop_after_prepare=wp.stop_after_prepare,
+        )
+        if wp.stop_after_read or wp.stop_after_prepare:
+            log.info("training stopped early by workflow params")
+            instances.delete(instance.id)
+            return None
+        persistable = engine.make_persistent_models(
+            ctx, engine_params, models, algos=algos
+        )
+        storage.models().insert(instance.id, serialize_models(persistable))
+        done = instance.completed()
+        instances.update(done)
+        log.info("training finished: engine instance %s", instance.id)
+        return done
+    except Exception:
+        import dataclasses as _dc
+
+        instances.update(
+            _dc.replace(instance, status="FAILED", end_time=_now())
+        )
+        raise
+
+
+def run_evaluation(
+    engine: Engine,
+    engine_params_list: Sequence[EngineParams],
+    evaluator: Any,
+    ctx: EngineContext | None = None,
+    evaluation_class: str = "",
+    engine_params_generator_class: str = "",
+    batch: str = "",
+    storage: StorageRuntime | None = None,
+) -> "EvaluationResult":
+    """Sweep engine-params, score each, pick the best (MetricEvaluator role)."""
+    from predictionio_tpu.eval.evaluator import EvaluationResult, MetricEvaluator
+
+    storage = storage or get_storage()
+    ctx = ctx or EngineContext(storage=storage, mode="eval")
+    instances = storage.evaluation_instances()
+    instance = EvaluationInstance(
+        id=uuid.uuid4().hex,
+        status="EVALUATING",
+        start_time=_now(),
+        end_time=_now(),
+        evaluation_class=evaluation_class,
+        engine_params_generator_class=engine_params_generator_class,
+        batch=batch,
+    )
+    instances.insert(instance)
+    try:
+        if not isinstance(evaluator, MetricEvaluator):
+            evaluator = MetricEvaluator(evaluator)
+        result = evaluator.evaluate(ctx, engine, engine_params_list)
+        import dataclasses as _dc
+
+        instances.update(
+            _dc.replace(
+                instance,
+                status="EVALCOMPLETED",
+                end_time=_now(),
+                evaluator_results=result.one_liner(),
+                evaluator_results_html=result.to_html(),
+                evaluator_results_json=result.to_json(),
+            )
+        )
+        return result
+    except Exception:
+        import dataclasses as _dc
+
+        instances.update(_dc.replace(instance, status="FAILED", end_time=_now()))
+        raise

@@ -1,0 +1,7 @@
+from predictionio_tpu.eval.evaluator import (
+    EvaluationResult,
+    MetricEvaluator,
+)
+from predictionio_tpu.eval.fast_eval import FastEvalEngine
+
+__all__ = ["EvaluationResult", "FastEvalEngine", "MetricEvaluator"]

@@ -1,0 +1,97 @@
+"""FastEvalEngine: per-prefix memoization for hyperparameter sweeps.
+
+Mirrors controller/FastEvalEngine.scala:46-345: when evaluating an
+engine-params list, many variants share a prefix of the pipeline
+(same datasource -> same eval sets; same +preparator -> same prepared data;
+same +algorithm params -> same trained models).  Caching on the serialized
+params prefix makes an N-variant sweep cost ~1 datasource read + P prepares +
+A trains instead of N of each.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Sequence
+
+from predictionio_tpu.core.base import EngineContext
+from predictionio_tpu.core.engine import Engine, EngineParams
+from predictionio_tpu.utils.params import params_to_dict
+from predictionio_tpu.utils.registry import doer
+
+
+def _key(*parts: Any) -> str:
+    return json.dumps(parts, sort_keys=True, default=str)
+
+
+class FastEvalEngine(Engine):
+    """Engine whose eval() memoizes datasource/preparator/algorithm prefixes."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._ds_cache: dict[str, Any] = {}
+        self._prep_cache: dict[str, Any] = {}
+        self._train_cache: dict[str, Any] = {}
+        # hit counters exposed for tests (FastEvalEngineTest counts cache use)
+        self.counts = {"datasource": 0, "preparator": 0, "train": 0}
+
+    @classmethod
+    def from_engine(cls, engine: Engine) -> "FastEvalEngine":
+        return cls(
+            engine.datasource_classes,
+            engine.preparator_classes,
+            engine.algorithm_classes,
+            engine.serving_classes,
+        )
+
+    def _eval_sets(self, ctx: EngineContext, params: EngineParams):
+        k = _key(params.datasource[0], params_to_dict(params.datasource[1]))
+        if k not in self._ds_cache:
+            self.counts["datasource"] += 1
+            ds = doer(
+                self.datasource_classes[params.datasource[0]], params.datasource[1]
+            )
+            self._ds_cache[k] = ds.read_eval(ctx)
+        return k, self._ds_cache[k]
+
+    def _prepared(self, ctx: EngineContext, params: EngineParams):
+        ds_key, eval_sets = self._eval_sets(ctx, params)
+        k = _key(ds_key, params.preparator[0], params_to_dict(params.preparator[1]))
+        if k not in self._prep_cache:
+            self.counts["preparator"] += 1
+            prep = doer(
+                self.preparator_classes[params.preparator[0]], params.preparator[1]
+            )
+            self._prep_cache[k] = [
+                prep.prepare(ctx, td) for td, _, _ in eval_sets
+            ]
+        return k, eval_sets, self._prep_cache[k]
+
+    def _models(self, ctx: EngineContext, params: EngineParams):
+        prep_key, eval_sets, pds = self._prepared(ctx, params)
+        per_algo_models = []
+        for name, algo_params in params.algorithms:
+            k = _key(prep_key, name, params_to_dict(algo_params))
+            if k not in self._train_cache:
+                self.counts["train"] += 1
+                algo = doer(self.algorithm_classes[name], algo_params)
+                self._train_cache[k] = [algo.train(ctx, pd) for pd in pds]
+            per_algo_models.append(self._train_cache[k])
+        return eval_sets, per_algo_models
+
+    def eval(self, ctx: EngineContext, params: EngineParams):
+        from predictionio_tpu.core.engine import serve_eval_fold
+
+        eval_sets, per_algo_models = self._models(ctx, params)
+        algos = [
+            doer(self.algorithm_classes[name], p) for name, p in params.algorithms
+        ]
+        serving = doer(
+            self.serving_classes[params.serving[0]], params.serving[1]
+        )
+        results = []
+        for fold, (td, eval_info, qa_pairs) in enumerate(eval_sets):
+            fold_models = [ms[fold] for ms in per_algo_models]
+            results.append(
+                (eval_info, serve_eval_fold(algos, fold_models, serving, qa_pairs))
+            )
+        return results

@@ -1,0 +1,9 @@
+"""Official engine templates, re-designed TPU-first.
+
+Parity targets (reference examples/):
+  - recommendation: explicit ALS (scala-parallel-recommendation)
+  - similarproduct: implicit ALS + cosine similarity (scala-parallel-similarproduct)
+  - classification: Naive Bayes / logistic regression (scala-parallel-classification)
+  - ecommerce: ALS + business-rule filters (scala-parallel-ecommercerecommendation)
+  - ncf: deep two-tower/NCF with sharded embeddings (pypio deep-rec config)
+"""

@@ -1,0 +1,27 @@
+from predictionio_tpu.models.recommendation.engine import (
+    ALSAlgorithm,
+    ALSAlgorithmParams,
+    ALSModel,
+    DataSourceParams,
+    ItemScore,
+    PredictedResult,
+    Query,
+    RatingsDataSource,
+    RatingsPreparator,
+    RecommendationServing,
+    recommendation_engine,
+)
+
+__all__ = [
+    "ALSAlgorithm",
+    "ALSAlgorithmParams",
+    "ALSModel",
+    "DataSourceParams",
+    "ItemScore",
+    "PredictedResult",
+    "Query",
+    "RatingsDataSource",
+    "RatingsPreparator",
+    "RecommendationServing",
+    "recommendation_engine",
+]

@@ -1,0 +1,3 @@
+from predictionio_tpu.ops.als import ALSParams, ALSState, train_als
+
+__all__ = ["ALSParams", "ALSState", "train_als"]

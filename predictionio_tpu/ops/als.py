@@ -1,0 +1,247 @@
+"""Alternating Least Squares as an XLA program over a device mesh.
+
+The TPU-native replacement for Spark MLlib's ALS used by the reference's
+recommendation templates (examples/scala-parallel-recommendation/.../
+ALSAlgorithm.scala:52 explicit; examples/scala-parallel-similarproduct/...
+ALS.trainImplicit implicit).  Where MLlib block-partitions factor matrices
+across executors and shuffles ratings, this implementation:
+
+  - keeps ratings as padded COO arrays sharded along the mesh ``data`` axis;
+  - computes per-entity normal equations with a chunked scatter-add
+    (``lax.scan`` over fixed-size chunks -> static shapes, no giant
+    [nnz, k, k] intermediate);
+  - ``psum``s the partial statistics over the mesh (XLA collective over ICI,
+    the shuffle replacement);
+  - solves the batched k x k systems with each device owning a slice of the
+    entities, then ``all_gather``s the updated factors.
+
+Explicit feedback solves  (Vu^T Vu + reg * I) x = Vu^T r_u  with MLlib's
+ALS-WR option of scaling reg by the per-entity rating count.  Implicit
+feedback (Hu-Koren) solves  (V^T V + Vu^T diag(alpha r) Vu + reg I) x =
+Vu^T (1 + alpha r) 1.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PSpec
+
+from predictionio_tpu.parallel.mesh import pad_to_multiple
+
+
+@dataclass(frozen=True)
+class ALSParams:
+    """Hyperparameters; defaults mirror the reference template's engine.json
+    (rank=10, numIterations=20, lambda=0.01, seed=3)."""
+
+    rank: int = 10
+    num_iterations: int = 20
+    reg: float = 0.01
+    implicit_prefs: bool = False
+    alpha: float = 1.0  # implicit confidence scale
+    scale_reg_with_count: bool = True  # MLlib ALS-WR lambda * n_u scaling
+    seed: int = 3
+    chunk_size: int = 1 << 16  # COO entries per scan step
+
+
+@dataclass
+class ALSState:
+    """Trained factors (host numpy after persistence; device arrays live)."""
+
+    user_factors: Any  # [num_users, rank]
+    item_factors: Any  # [num_items, rank]
+
+
+def _pvary(x, axis):
+    """Mark a freshly-created array as varying over a shard_map axis.
+
+    Inside shard_map, zeros created in the body are 'unvarying' while scan
+    outputs fed by sharded operands are 'varying'; the carry types must match
+    (jax >= 0.9 vma checking)."""
+    if axis is None:
+        return x
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, (axis,), to="varying")
+    return jax.lax.pvary(x, (axis,))  # pre-pcast jax versions
+
+
+def _segment_stats(idx, vecs, weights, rhs, num_segments, chunk_size, axis=None):
+    """Accumulate A[s] += w * v v^T and b[s] += rhs * v per segment.
+
+    Chunked scatter-add: reshapes the (padded) COO stream into
+    [n_chunks, chunk_size, ...] and scans, so the peak intermediate is
+    [chunk_size, k, k] instead of [nnz, k, k].
+    """
+    n, k = vecs.shape
+    n_chunks = n // chunk_size
+    A0 = _pvary(jnp.zeros((num_segments, k, k), vecs.dtype), axis)
+    b0 = _pvary(jnp.zeros((num_segments, k), vecs.dtype), axis)
+
+    def body(carry, chunk):
+        A, b = carry
+        ci, cv, cw, cr = chunk
+        outer = (cv[:, :, None] * cv[:, None, :]) * cw[:, None, None]
+        A = A.at[ci].add(outer, mode="drop")
+        b = b.at[ci].add(cv * cr[:, None], mode="drop")
+        return (A, b), None
+
+    chunks = (
+        idx.reshape(n_chunks, chunk_size),
+        vecs.reshape(n_chunks, chunk_size, k),
+        weights.reshape(n_chunks, chunk_size),
+        rhs.reshape(n_chunks, chunk_size),
+    )
+    (A, b), _ = jax.lax.scan(body, (A0, b0), chunks)
+    return A, b
+
+
+def _solve_factors(A, b, counts, reg, scale_reg, gram=None):
+    """Solve (A + reg' I [+ gram]) x = b batched over the leading axis."""
+    k = b.shape[-1]
+    reg_eff = reg * jnp.maximum(counts, 1.0) if scale_reg else jnp.full_like(counts, reg)
+    lhs = A + reg_eff[:, None, None] * jnp.eye(k, dtype=A.dtype)
+    if gram is not None:
+        lhs = lhs + gram
+    # cho_solve on k x k SPD systems; batched over entities on the MXU.
+    chol = jax.scipy.linalg.cholesky(lhs, lower=True)
+    x = jax.scipy.linalg.cho_solve((chol, True), b[..., None])
+    return x[..., 0]
+
+
+def _half_step(
+    seg_idx,  # [nnz_local] entity being solved (sharded over 'data')
+    other_idx,  # [nnz_local] opposite entity
+    rating,  # [nnz_local]
+    valid,  # [nnz_local] 1.0 real / 0.0 padding
+    other_factors,  # [num_other_pad, k] replicated
+    num_seg_pad: int,
+    p: ALSParams,
+    axis: str | None,
+):
+    """One alternating update: recompute factors for ``seg`` entities."""
+    v = other_factors[other_idx]
+    if p.implicit_prefs:
+        conf_minus_1 = p.alpha * rating * valid
+        a_weight = conf_minus_1  # Vu^T diag(c-1) Vu part
+        rhs = (1.0 + conf_minus_1) * valid  # c * p with p=1
+        # other_factors is replicated, so the Gram needs no collective.
+        gram = other_factors.T @ other_factors
+    else:
+        a_weight = valid
+        rhs = rating * valid
+        gram = None
+    A, b = _segment_stats(seg_idx, v, a_weight, rhs, num_seg_pad, p.chunk_size, axis)
+    counts = _pvary(jnp.zeros((num_seg_pad,), v.dtype), axis).at[seg_idx].add(
+        valid, mode="drop"
+    )
+    if axis:
+        A = jax.lax.psum(A, axis)
+        b = jax.lax.psum(b, axis)
+        counts = jax.lax.psum(counts, axis)
+    if axis:
+        n_dev = jax.lax.axis_size(axis)
+        slice_size = num_seg_pad // n_dev
+        start = jax.lax.axis_index(axis) * slice_size
+        A_loc = jax.lax.dynamic_slice_in_dim(A, start, slice_size)
+        b_loc = jax.lax.dynamic_slice_in_dim(b, start, slice_size)
+        c_loc = jax.lax.dynamic_slice_in_dim(counts, start, slice_size)
+        x_loc = _solve_factors(
+            A_loc, b_loc, c_loc, p.reg, p.scale_reg_with_count, gram
+        )
+        return jax.lax.all_gather(x_loc, axis, axis=0, tiled=True)
+    return _solve_factors(A, b, counts, p.reg, p.scale_reg_with_count, gram)
+
+
+def _make_train_step(mesh: Mesh | None, num_users_pad, num_items_pad, p: ALSParams):
+    """Build the jitted one-iteration function (user solve then item solve)."""
+
+    def step(u_idx, i_idx, rating, valid, U, V):
+        axis = "data" if mesh is not None else None
+        U = _half_step(u_idx, i_idx, rating, valid, V, num_users_pad, p, axis)
+        V = _half_step(i_idx, u_idx, rating, valid, U, num_items_pad, p, axis)
+        return U, V
+
+    if mesh is None:
+        return jax.jit(step)
+
+    coo_spec = PSpec("data")
+    repl = PSpec(None, None)
+    # check_vma=False: outputs are all_gather'ed, hence replicated in value,
+    # but the static vma analysis cannot prove it.
+    sharded_step = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(coo_spec, coo_spec, coo_spec, coo_spec, repl, repl),
+        out_specs=(repl, repl),
+        check_vma=False,
+    )
+    return jax.jit(sharded_step)
+
+
+def train_als(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    rating: np.ndarray,
+    num_users: int,
+    num_items: int,
+    params: ALSParams | None = None,
+    mesh: Mesh | None = None,
+    dtype=jnp.float32,
+) -> ALSState:
+    """Train ALS factors from COO ratings.
+
+    Entity counts are padded so each mesh device owns an equal factor slice;
+    the COO stream is padded to a chunk multiple with valid=0 entries.
+    Returns device arrays (callers device_get for persistence).
+    """
+    p = params or ALSParams()
+    n_dev = mesh.devices.size if mesh is not None else 1
+    lane = 8 * n_dev  # keep slices sublane-aligned and evenly divisible
+    num_users_pad = max(math.ceil(num_users / lane) * lane, lane)
+    num_items_pad = max(math.ceil(num_items / lane) * lane, lane)
+
+    chunk_total = p.chunk_size * n_dev
+    u, n_real = pad_to_multiple(np.asarray(user_idx, np.int32), chunk_total)
+    i, _ = pad_to_multiple(np.asarray(item_idx, np.int32), chunk_total)
+    r, _ = pad_to_multiple(np.asarray(rating, np.float32), chunk_total)
+    valid = np.zeros(len(u), np.float32)
+    valid[:n_real] = 1.0
+    # padding rows scatter into a real segment with weight 0 — harmless
+    u[n_real:] = 0
+    i[n_real:] = 0
+
+    key = jax.random.PRNGKey(p.seed)
+    ku, kv = jax.random.split(key)
+    # MLlib-style nonnegative init (abs of gaussians, scaled): keeps initial
+    # scores O(1) and positive, which conditions ALS well on rating data.
+    # Padded rows are zeroed so the implicit-feedback Gram (Y^T Y) sees only
+    # real entities.
+    U0 = jnp.abs(jax.random.normal(ku, (num_users_pad, p.rank), dtype)) / math.sqrt(p.rank)
+    V0 = jnp.abs(jax.random.normal(kv, (num_items_pad, p.rank), dtype)) / math.sqrt(p.rank)
+    U0 = U0.at[num_users:].set(0.0)
+    V0 = V0.at[num_items:].set(0.0)
+
+    if mesh is not None:
+        coo_sh = NamedSharding(mesh, PSpec("data"))
+        repl_sh = NamedSharding(mesh, PSpec(None, None))
+        u = jax.device_put(u, coo_sh)
+        i = jax.device_put(i, coo_sh)
+        r = jax.device_put(r, coo_sh)
+        valid = jax.device_put(valid, coo_sh)
+        U0 = jax.device_put(U0, repl_sh)
+        V0 = jax.device_put(V0, repl_sh)
+
+    step = _make_train_step(mesh, num_users_pad, num_items_pad, p)
+    U, V = U0, V0
+    for _ in range(p.num_iterations):
+        U, V = step(u, i, r, valid, U, V)
+    U = jax.block_until_ready(U)
+    return ALSState(user_factors=U[:num_users], item_factors=V[:num_items])

@@ -1,0 +1,7 @@
+from predictionio_tpu.parallel.mesh import (
+    MeshConfig,
+    default_mesh,
+    make_mesh,
+)
+
+__all__ = ["MeshConfig", "default_mesh", "make_mesh"]

@@ -1,0 +1,109 @@
+"""Device-mesh construction and sharding helpers.
+
+The Spark-replacement substrate: where the reference creates a SparkContext
+per workflow (workflow/WorkflowContext.scala:28) and distributes via RDD
+partitioning, this framework builds a ``jax.sharding.Mesh`` over the TPU
+slice (ICI) — multi-host via ``jax.distributed`` — and shards arrays with
+NamedSharding/shard_map.  Collectives (psum/all_gather/reduce_scatter) are
+inserted by XLA from the sharding annotations.
+
+Axis convention:
+  - ``data``  — batch/data parallelism (events, queries, rating rows)
+  - ``model`` — parameter sharding (embedding/factor-table rows)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh shape, recorded into EngineInstance.mesh_conf.
+
+    ``axes`` maps axis name -> size; a size of -1 means "all remaining
+    devices".  Empty axes = one-device mesh (local/L-flavor compute).
+    """
+
+    axes: dict[str, int] = field(default_factory=lambda: {"data": -1})
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"axes": dict(self.axes)}
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any] | None) -> "MeshConfig":
+        if not d or not d.get("axes"):
+            return cls()
+        return cls(axes=dict(d["axes"]))
+
+
+def make_mesh(
+    config: MeshConfig | None = None, devices: Sequence[jax.Device] | None = None
+) -> Mesh:
+    """Build a Mesh from a MeshConfig over the given (default: all) devices."""
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    axes = dict(config.axes) or {"data": -1}
+    names = list(axes)
+    sizes = list(axes.values())
+    n = len(devices)
+    fixed = int(np.prod([s for s in sizes if s != -1])) if sizes else 1
+    n_wild = sum(1 for s in sizes if s == -1)
+    if n_wild > 1:
+        raise ValueError("at most one mesh axis may be -1 (auto)")
+    if n_wild == 1:
+        if n % fixed != 0:
+            raise ValueError(
+                f"{n} devices not divisible by fixed axes product {fixed}"
+            )
+        sizes = [n // fixed if s == -1 else s for s in sizes]
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, have {n}")
+    mesh_devices = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(mesh_devices, axis_names=tuple(names))
+
+
+def default_mesh() -> Mesh:
+    """All addressable devices on one ``data`` axis."""
+    return make_mesh(MeshConfig())
+
+
+def named_sharding(mesh: Mesh, *spec: str | None) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def initialize_distributed() -> None:
+    """Multi-host init (jax.distributed.initialize) driven by env vars.
+
+    The NCCL/MPI-free analog of the reference's cluster bootstrap: each TPU-VM
+    worker calls this once; XLA then runs collectives over ICI within a slice
+    and DCN across slices.  No-op for single-process runs.
+    """
+    if os.environ.get("PIO_COORDINATOR_ADDRESS"):
+        jax.distributed.initialize(
+            coordinator_address=os.environ["PIO_COORDINATOR_ADDRESS"],
+            num_processes=int(os.environ.get("PIO_NUM_PROCESSES", "1")),
+            process_id=int(os.environ.get("PIO_PROCESS_ID", "0")),
+        )
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0, fill=0):
+    """Pad an array along ``axis`` so its size divides evenly for sharding.
+
+    Returns (padded, original_size).  Static-shape-friendly: callers mask with
+    the original size inside jit instead of slicing dynamically.
+    """
+    size = arr.shape[axis]
+    target = ((size + multiple - 1) // multiple) * multiple
+    if target == size:
+        return arr, size
+    pad_widths = [(0, 0)] * arr.ndim
+    pad_widths[axis] = (0, target - size)
+    return np.pad(arr, pad_widths, constant_values=fill), size

@@ -31,10 +31,19 @@ class EmptyParams(Params):
 
 
 def extract_params(cls: Type[T], payload: Mapping[str, Any] | None) -> T:
-    """Build a params dataclass from a JSON object, coercing nested fields."""
-    payload = payload or {}
+    """Build a params dataclass from a JSON object, coercing nested fields.
+
+    A ``params_aliases`` classvar (dict json-name -> field-name) lets params
+    classes accept the reference's JSON spellings (e.g. ``lambda`` -> ``reg``,
+    which cannot be a Python field name).
+    """
+    payload = dict(payload or {})
     if not dataclasses.is_dataclass(cls):
         raise ParamsError(f"{cls!r} is not a dataclass params type")
+    aliases: Mapping[str, str] = getattr(cls, "params_aliases", {})
+    for json_name, field_name in aliases.items():
+        if json_name in payload:
+            payload[field_name] = payload.pop(json_name)
     hints = typing.get_type_hints(cls)
     names = {f.name for f in dataclasses.fields(cls)}
     unknown = set(payload) - names

@@ -2,17 +2,27 @@
 
 Distributed logic is tested the way the reference tests Spark code with
 ``local[*]`` (SURVEY.md §4): a virtual 8-device CPU mesh via
-``--xla_force_host_platform_device_count=8``.  Must be set before jax import.
+``--xla_force_host_platform_device_count=8``.
+
+The machine profile may pre-import jax bound to the real TPU
+(JAX_PLATFORMS=axon via sitecustomize), so setting env vars is not enough:
+when jax is already in sys.modules we must also update jax.config.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

@@ -1,0 +1,120 @@
+"""Fake DASE components for core tests.
+
+The analog of the reference's central test fixture family Engine0.*
+(core/src/test/scala/.../controller/SampleEngine.scala:33-400): deterministic
+integer-id data with error-injection flags that trip sanity checks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from predictionio_tpu.core import (
+    Algorithm,
+    AverageMetric,
+    DataSource,
+    EngineContext,
+    Preparator,
+    SanityCheckError,
+    Serving,
+)
+
+
+@dataclass
+class TrainingData:
+    id: int
+    error: bool = False
+
+    def sanity_check(self):
+        if self.error:
+            raise SanityCheckError(f"TrainingData {self.id} flagged error")
+
+
+@dataclass
+class PreparedData:
+    id: int
+    multiplier: int = 1
+
+
+@dataclass
+class FakeModel:
+    id: int
+    multiplier: int
+
+
+@dataclass(frozen=True)
+class DSParams:
+    id: int = 0
+    error: bool = False
+    n_folds: int = 2
+    n_queries: int = 3
+
+
+class DataSource0(DataSource):
+    params_class = DSParams
+
+    def __init__(self, params: DSParams | None = None):
+        self.params = params or DSParams()
+
+    def read_training(self, ctx: EngineContext) -> TrainingData:
+        return TrainingData(id=self.params.id, error=self.params.error)
+
+    def read_eval(self, ctx):
+        # fold f: queries q -> actual = q (identity ground truth)
+        return [
+            (
+                TrainingData(id=self.params.id),
+                {"fold": f},
+                [(q, float(q)) for q in range(self.params.n_queries)],
+            )
+            for f in range(self.params.n_folds)
+        ]
+
+
+@dataclass(frozen=True)
+class PrepParams:
+    multiplier: int = 1
+
+
+class Preparator0(Preparator):
+    params_class = PrepParams
+
+    def __init__(self, params: PrepParams | None = None):
+        self.params = params or PrepParams()
+
+    def prepare(self, ctx, td: TrainingData) -> PreparedData:
+        return PreparedData(id=td.id, multiplier=self.params.multiplier)
+
+
+@dataclass(frozen=True)
+class AlgoParams:
+    offset: float = 0.0
+
+
+class Algo0(Algorithm):
+    """predict(q) = q * multiplier + offset."""
+
+    params_class = AlgoParams
+    train_count = 0  # class-level: tracks real trains for FastEval tests
+
+    def __init__(self, params: AlgoParams | None = None):
+        self.params = params or AlgoParams()
+
+    def train(self, ctx, pd: PreparedData) -> FakeModel:
+        type(self).train_count += 1
+        return FakeModel(id=pd.id, multiplier=pd.multiplier)
+
+    def predict(self, model: FakeModel, query) -> float:
+        return float(query) * model.multiplier + self.params.offset
+
+
+class Serving0(Serving):
+    def serve(self, query, predictions):
+        return sum(predictions) / len(predictions)
+
+
+class AbsErrorMetric(AverageMetric):
+    """Mean |p - a| — negated so larger is better stays consistent."""
+
+    def calculate_one(self, q, p, a) -> float:
+        return -abs(p - a)

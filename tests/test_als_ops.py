@@ -1,0 +1,84 @@
+"""ALS kernel: convergence, mesh-vs-single-device parity, implicit variant."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.als import ALSParams, train_als
+from predictionio_tpu.parallel.mesh import MeshConfig, default_mesh, make_mesh
+
+
+@pytest.fixture(scope="module")
+def ratings():
+    rng = np.random.default_rng(0)
+    nu, ni, k = 200, 100, 5
+    U = np.abs(rng.normal(size=(nu, k)))
+    V = np.abs(rng.normal(size=(ni, k)))
+    n = 5000
+    ui = rng.integers(0, nu, n).astype(np.int32)
+    ii = rng.integers(0, ni, n).astype(np.int32)
+    r = (U[ui] * V[ii]).sum(1).astype(np.float32)
+    return nu, ni, ui, ii, r
+
+
+def rmse(state, ui, ii, r):
+    pred = (np.asarray(state.user_factors)[ui] * np.asarray(state.item_factors)[ii]).sum(1)
+    return float(np.sqrt(((pred - r) ** 2).mean()))
+
+
+P = ALSParams(rank=5, num_iterations=15, reg=0.01, chunk_size=1024,
+              scale_reg_with_count=False)
+
+
+class TestExplicit:
+    def test_fits_low_rank_data(self, ratings):
+        nu, ni, ui, ii, r = ratings
+        st = train_als(ui, ii, r, nu, ni, P)
+        assert rmse(st, ui, ii, r) < 0.05 * r.mean()
+
+    def test_mesh_matches_single_device(self, ratings):
+        nu, ni, ui, ii, r = ratings
+        st1 = train_als(ui, ii, r, nu, ni, P)
+        st8 = train_als(ui, ii, r, nu, ni, P, mesh=default_mesh())
+        np.testing.assert_allclose(
+            np.asarray(st1.user_factors),
+            np.asarray(st8.user_factors),
+            atol=2e-3,
+        )
+
+    def test_deterministic_given_seed(self, ratings):
+        nu, ni, ui, ii, r = ratings
+        a = train_als(ui, ii, r, nu, ni, P)
+        b = train_als(ui, ii, r, nu, ni, P)
+        np.testing.assert_array_equal(
+            np.asarray(a.user_factors), np.asarray(b.user_factors)
+        )
+
+    def test_factor_shapes_unpadded(self, ratings):
+        nu, ni, ui, ii, r = ratings
+        st = train_als(ui, ii, r, nu, ni, P, mesh=default_mesh())
+        assert np.asarray(st.user_factors).shape == (nu, P.rank)
+        assert np.asarray(st.item_factors).shape == (ni, P.rank)
+
+
+class TestImplicit:
+    def test_observed_preference_near_one(self, ratings):
+        nu, ni, ui, ii, r = ratings
+        p = ALSParams(rank=5, num_iterations=5, reg=0.01, implicit_prefs=True,
+                      alpha=40.0, chunk_size=1024, scale_reg_with_count=False)
+        st = train_als(ui, ii, r, nu, ni, p, mesh=default_mesh())
+        s = (np.asarray(st.user_factors)[ui] * np.asarray(st.item_factors)[ii]).sum(1)
+        assert 0.8 < float(s.mean()) < 1.1
+
+
+class TestMeshConfig:
+    def test_axes_resolution(self):
+        m = make_mesh(MeshConfig({"data": 4, "model": 2}))
+        assert m.shape == {"data": 4, "model": 2}
+        m2 = make_mesh(MeshConfig({"data": -1}))
+        assert m2.devices.size == 8
+
+    def test_bad_configs(self):
+        with pytest.raises(ValueError):
+            make_mesh(MeshConfig({"data": -1, "model": -1}))
+        with pytest.raises(ValueError):
+            make_mesh(MeshConfig({"data": 16}))

@@ -1,0 +1,203 @@
+"""Engine train/eval pipelines + workflows (reference: EngineTest, EngineWorkflowTest,
+EvaluationWorkflowTest, FastEvalEngineTest, MetricEvaluatorTest)."""
+
+import json
+
+import pytest
+
+from predictionio_tpu.core import Engine, EngineContext, EngineParams, SanityCheckError
+from predictionio_tpu.core.persistence import deserialize_models, serialize_models
+from predictionio_tpu.core.workflow import WorkflowParams, run_evaluation, run_train
+from predictionio_tpu.eval import FastEvalEngine, MetricEvaluator
+
+from sample_engine import (
+    AbsErrorMetric,
+    Algo0,
+    AlgoParams,
+    DataSource0,
+    DSParams,
+    FakeModel,
+    Preparator0,
+    PrepParams,
+    Serving0,
+)
+
+
+def make_engine() -> Engine:
+    return Engine(
+        {"ds0": DataSource0},
+        {"prep0": Preparator0},
+        {"algo0": Algo0},
+        {"serving0": Serving0},
+    )
+
+
+def make_params(offsets=(0.0,), multiplier=1, ds=DSParams()) -> EngineParams:
+    return EngineParams(
+        datasource=("ds0", ds),
+        preparator=("prep0", PrepParams(multiplier=multiplier)),
+        algorithms=tuple(("algo0", AlgoParams(offset=o)) for o in offsets),
+        serving=("serving0", None),
+    )
+
+
+@pytest.fixture()
+def ctx(storage):
+    return EngineContext(storage=storage)
+
+
+class TestEngineTrain:
+    def test_train_produces_models_per_algo(self, ctx):
+        models = make_engine().train(ctx, make_params(offsets=(0.0, 1.0), multiplier=3))
+        assert models == [FakeModel(0, 3), FakeModel(0, 3)]
+
+    def test_sanity_check_failure_aborts(self, ctx):
+        with pytest.raises(SanityCheckError):
+            make_engine().train(ctx, make_params(ds=DSParams(error=True)))
+
+    def test_skip_sanity_check(self, ctx):
+        models = make_engine().train(
+            ctx, make_params(ds=DSParams(error=True)), skip_sanity_check=True
+        )
+        assert len(models) == 1
+
+    def test_stop_after_read(self, ctx):
+        assert make_engine().train(ctx, make_params(), stop_after_read=True) == []
+
+
+class TestParamsFromJson:
+    def test_engine_json_shape(self):
+        variant = {
+            "datasource": {"name": "ds0", "params": {"id": 5, "n_folds": 3}},
+            "preparator": {"name": "prep0", "params": {"multiplier": 2}},
+            "algorithms": [
+                {"name": "algo0", "params": {"offset": 0.5}},
+                {"name": "algo0", "params": {"offset": 1.5}},
+            ],
+            "serving": {"name": "serving0"},
+        }
+        ep = make_engine().params_from_json(variant)
+        assert ep.datasource == ("ds0", DSParams(id=5, n_folds=3))
+        assert ep.preparator == ("prep0", PrepParams(multiplier=2))
+        assert [p.offset for _, p in ep.algorithms] == [0.5, 1.5]
+
+    def test_defaults_when_omitted(self):
+        ep = make_engine().params_from_json({})
+        assert ep.datasource == ("ds0", DSParams())
+        assert len(ep.algorithms) == 1
+
+    def test_unknown_param_rejected(self):
+        from predictionio_tpu.utils.params import ParamsError
+
+        with pytest.raises(ParamsError):
+            make_engine().params_from_json(
+                {"datasource": {"name": "ds0", "params": {"bogus": 1}}}
+            )
+
+    def test_json_fields_roundtrip(self):
+        fields = make_params(offsets=(0.5,)).to_json_fields()
+        assert json.loads(fields["algorithms_params"]) == [
+            {"algo0": {"offset": 0.5}}
+        ]
+
+
+class TestEngineEval:
+    def test_eval_serves_mean_of_algos(self, ctx):
+        # two algos offsets 0 and 2 -> serving averages to q*1 + 1
+        results = make_engine().eval(ctx, make_params(offsets=(0.0, 2.0)))
+        assert len(results) == 2  # folds
+        for _, qpas in results:
+            for q, p, a in qpas:
+                assert p == pytest.approx(float(q) + 1.0)
+                assert a == float(q)
+
+
+class TestTrainWorkflow:
+    def test_run_train_persists_and_completes(self, ctx, storage):
+        inst = run_train(
+            make_engine(),
+            make_params(multiplier=2),
+            ctx=ctx,
+            engine_factory="tests:make_engine",
+            storage=storage,
+        )
+        assert inst.status == "COMPLETED"
+        stored = storage.engine_instances().get(inst.id)
+        assert stored.status == "COMPLETED"
+        assert json.loads(stored.preparator_params) == {"prep0": {"multiplier": 2}}
+        models = deserialize_models(storage.models().get(inst.id))
+        assert models == [FakeModel(0, 2)]
+
+    def test_run_train_failure_records_failed(self, ctx, storage):
+        with pytest.raises(SanityCheckError):
+            run_train(
+                make_engine(),
+                make_params(ds=DSParams(error=True)),
+                ctx=ctx,
+                storage=storage,
+            )
+        all_instances = storage.engine_instances().get_all()
+        assert [i.status for i in all_instances] == ["FAILED"]
+
+
+class TestEvaluationWorkflow:
+    def test_sweep_picks_best(self, ctx, storage):
+        # offset 0 is a perfect model (score 0); larger offsets are worse
+        params_list = [make_params(offsets=(o,)) for o in (3.0, 0.0, 1.0)]
+        result = run_evaluation(
+            make_engine(),
+            params_list,
+            AbsErrorMetric(),
+            ctx=ctx,
+            storage=storage,
+        )
+        assert result.best_idx == 1
+        assert result.best.score == pytest.approx(0.0)
+        insts = storage.evaluation_instances().get_completed()
+        assert len(insts) == 1
+        assert "best score" in insts[0].evaluator_results
+        assert json.loads(insts[0].evaluator_results_json)["bestIdx"] == 1
+
+
+class TestFastEval:
+    def test_prefix_memoization(self, ctx):
+        engine = FastEvalEngine(
+            {"ds0": DataSource0},
+            {"prep0": Preparator0},
+            {"algo0": Algo0},
+            {"serving0": Serving0},
+        )
+        # 4 variants: same ds; 2 preparators; algo params vary within preparator
+        sweep = [
+            make_params(offsets=(0.0,), multiplier=1),
+            make_params(offsets=(1.0,), multiplier=1),
+            make_params(offsets=(0.0,), multiplier=2),
+            make_params(offsets=(0.0,), multiplier=1),  # repeat of first
+        ]
+        before = Algo0.train_count
+        MetricEvaluator(AbsErrorMetric()).evaluate(ctx, engine, sweep)
+        assert engine.counts["datasource"] == 1
+        assert engine.counts["preparator"] == 2  # multiplier 1 and 2
+        # trains: (prep1, offset0), (prep1, offset1), (prep2, offset0) = 3 keys
+        # x 2 folds each
+        assert engine.counts["train"] == 3
+        assert Algo0.train_count - before == 6
+
+    def test_matches_slow_engine(self, ctx):
+        sweep = [make_params(offsets=(0.0, 2.0)), make_params(offsets=(1.0,))]
+        slow = MetricEvaluator(AbsErrorMetric()).evaluate(ctx, make_engine(), sweep)
+        fast_engine = FastEvalEngine.from_engine(make_engine())
+        fast = MetricEvaluator(AbsErrorMetric()).evaluate(ctx, fast_engine, sweep)
+        assert [r.score for r in slow.records] == [r.score for r in fast.records]
+
+
+class TestPersistence:
+    def test_jax_arrays_become_numpy(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        blob = serialize_models([{"w": jnp.arange(4.0), "meta": "x"}])
+        [m] = deserialize_models(blob)
+        assert isinstance(m["w"], np.ndarray)
+        np.testing.assert_allclose(m["w"], [0, 1, 2, 3])
+        assert m["meta"] == "x"

@@ -1,0 +1,182 @@
+"""End-to-end recommendation template: events -> train -> persist -> serve -> eval.
+
+The analog of the reference's quickstart integration scenario
+(tests/pio_tests/scenarios/quickstart_test.py): import MovieLens-style
+rate/buy events, train ALS, check recommendations, run the Precision@K sweep.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core import EngineContext, EngineParams
+from predictionio_tpu.core.persistence import deserialize_models
+from predictionio_tpu.core.workflow import run_evaluation, run_train
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.models.recommendation import (
+    ALSAlgorithm,
+    ALSAlgorithmParams,
+    DataSourceParams,
+    Query,
+    recommendation_engine,
+)
+from predictionio_tpu.models.recommendation.engine import EvalParams
+from predictionio_tpu.models.recommendation.evaluation import (
+    PositiveCount,
+    PrecisionAtK,
+    engine_params_list,
+)
+
+
+@pytest.fixture()
+def movie_app(storage):
+    """Synthetic two-taste-cluster ratings: users u0..u19, items m0..m29."""
+    app_id = storage.apps().insert(App(id=0, name="movies"))
+    le = storage.l_events()
+    le.init(app_id)
+    rng = np.random.default_rng(7)
+    events = []
+    for u in range(20):
+        cluster = u % 2
+        for i in range(30):
+            item_cluster = 0 if i < 15 else 1
+            base = 4.5 if cluster == item_cluster else 1.5
+            if rng.random() < 0.7:
+                events.append(
+                    Event(
+                        event="rate",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"m{i}",
+                        properties=DataMap(
+                            {"rating": float(np.clip(base + rng.normal(0, 0.3), 1, 5))}
+                        ),
+                    )
+                )
+    # a few buy events (implicit 4.0)
+    events.append(
+        Event(event="buy", entity_type="user", entity_id="u0",
+              target_entity_type="item", target_entity_id="m3")
+    )
+    le.insert_batch(events, app_id)
+    return storage
+
+
+def make_params(app="movies", iters=10, rank=8):
+    return EngineParams(
+        datasource=("ratings", DataSourceParams(app_name=app)),
+        preparator=("ratings", None),
+        algorithms=(("als", ALSAlgorithmParams(rank=rank, num_iterations=iters)),),
+        serving=("first", None),
+    )
+
+
+class TestQuickstart:
+    def test_train_serve_roundtrip(self, movie_app):
+        storage = movie_app
+        ctx = EngineContext(storage=storage)
+        engine = recommendation_engine()
+        inst = run_train(
+            engine, make_params(), ctx=ctx, storage=storage,
+            engine_factory="recommendation",
+        )
+        assert inst.status == "COMPLETED"
+
+        # reload as deploy does, then query
+        persisted = deserialize_models(storage.models().get(inst.id))
+        ep = make_params()
+        [model] = engine.prepare_deploy(ctx, ep, persisted)
+        algo = ALSAlgorithm(ep.algorithms[0][1])
+        result = algo.predict(model, Query(user="u0", num=5))
+        assert len(result.item_scores) == 5
+        # u0 is in cluster 0 -> top recs should be cluster-0 items (m0..m14)
+        top_items = [s.item for s in result.item_scores]
+        cluster0 = sum(1 for it in top_items if int(it[1:]) < 15)
+        assert cluster0 >= 4, f"expected cluster-0 recs, got {top_items}"
+        # scores sorted descending
+        scores = [s.score for s in result.item_scores]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_unknown_user_empty(self, movie_app):
+        ctx = EngineContext(storage=movie_app)
+        engine = recommendation_engine()
+        [model] = engine.train(ctx, make_params(iters=2, rank=4))
+        algo = ALSAlgorithm(ALSAlgorithmParams(rank=4))
+        assert algo.predict(model, Query(user="nobody")).item_scores == ()
+
+    def test_batch_predict_matches_predict(self, movie_app):
+        ctx = EngineContext(storage=movie_app)
+        engine = recommendation_engine()
+        ep = make_params(iters=5)
+        [model] = engine.train(ctx, ep)
+        algo = ALSAlgorithm(ep.algorithms[0][1])
+        queries = [(0, Query("u1", 5)), (1, Query("nobody", 5)), (2, Query("u2", 3))]
+        by_idx = dict(algo.batch_predict(model, queries))
+        assert [s.item for s in by_idx[0].item_scores] == [
+            s.item for s in algo.predict(model, Query("u1", 5)).item_scores
+        ]
+        assert by_idx[1].item_scores == ()
+        assert len(by_idx[2].item_scores) == 3
+
+    def test_engine_json_variant(self, movie_app):
+        engine = recommendation_engine()
+        ep = engine.params_from_json(
+            {
+                "datasource": {
+                    "name": "ratings",
+                    "params": {"app_name": "movies"},
+                },
+                "algorithms": [
+                    {
+                        "name": "als",
+                        "params": {"rank": 6, "num_iterations": 3, "reg": 0.05},
+                    }
+                ],
+            }
+        )
+        assert ep.algorithms[0][1].rank == 6
+        ctx = EngineContext(storage=movie_app)
+        [model] = engine.train(ctx, ep)
+        assert np.asarray(model.user_factors).shape[1] == 6
+
+
+class TestEvaluation:
+    def test_precision_at_k_sweep(self, movie_app):
+        storage = movie_app
+        ctx = EngineContext(storage=storage, mode="eval")
+        sweep = engine_params_list(
+            "movies",
+            ranks=(4,),
+            regs=(0.05, 10.0),  # huge reg should be worse
+            num_iterations=5,
+            eval_params=EvalParams(k_fold=2, query_num=5, rating_threshold=4.0),
+        )
+        result = run_evaluation(
+            recommendation_engine(),
+            sweep,
+            PrecisionAtK(k=5),
+            ctx=ctx,
+            storage=storage,
+        )
+        assert len(result.records) == 2
+        # good reg must beat absurd reg; absolute precision is structurally
+        # low because top-N includes train-fold items (reference semantics)
+        assert result.best_idx == 0
+        assert result.best.score > 0.15
+        assert result.best.score > result.records[1].score
+        pc = PositiveCount()
+        # sanity: metric machinery runs on the same folds
+        assert result.records[0].score <= 1.0
+
+
+class TestSanity:
+    def test_empty_events_fails_sanity(self, storage):
+        storage.apps().insert(App(id=0, name="empty"))
+        storage.l_events().init(1)
+        from predictionio_tpu.core import SanityCheckError
+
+        with pytest.raises(SanityCheckError):
+            recommendation_engine().train(
+                EngineContext(storage=storage), make_params(app="empty")
+            )
