@@ -1,0 +1,97 @@
+"""Headline benchmark: ALS full train at MovieLens-20M scale.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "s", "vs_baseline": N}
+
+The reference publishes no benchmark numbers (SURVEY.md §6), so the baseline
+is the driver-set north-star from BASELINE.json: full ALS train on
+MovieLens-20M in < 60 s on a TPU v5e-8 (reference hyperparams rank=10,
+20 iterations, lambda=0.01 — examples/scala-parallel-recommendation/
+customize-serving/engine.json:14-21).  ``vs_baseline`` is the speedup vs that
+60 s budget (>1.0 = beating the target).
+
+Ratings are synthetic at the ML-20M shape (20M ratings, ~138k users, ~27k
+items) generated host-side; the timed region is the full train loop
+(compile excluded by a one-iteration warmup, which also measures epoch cost).
+On non-TPU hosts (CI smoke) the problem is scaled down and the budget scaled
+with it, so the line stays comparable in spirit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from predictionio_tpu.ops.als import ALSParams, train_als
+    from predictionio_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    scale = float(os.environ.get("PIO_BENCH_SCALE", "1.0" if on_tpu else "0.01"))
+
+    nnz = int(20_000_000 * scale)
+    num_users = max(int(138_493 * scale), 64)
+    num_items = max(int(26_744 * scale), 48)
+    budget_s = 60.0 * max(scale, 1e-6)
+
+    rng = np.random.default_rng(3)
+    user_idx = rng.integers(0, num_users, nnz, dtype=np.int64)
+    item_idx = rng.integers(0, num_items, nnz, dtype=np.int64)
+    # low-rank planted structure so the solves are numerically realistic
+    uf = rng.standard_normal((num_users, 4)).astype(np.float32)
+    vf = rng.standard_normal((num_items, 4)).astype(np.float32)
+    rating = np.clip(
+        2.5 + np.einsum("nk,nk->n", uf[user_idx], vf[item_idx]), 0.5, 5.0
+    ).astype(np.float32)
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(MeshConfig(axes={"data": n_dev})) if n_dev > 1 else None
+    params = ALSParams(rank=10, reg=0.01, seed=3)
+
+    # Warmup: compile + one epoch (epoch time printed to stderr for tracking).
+    t0 = time.perf_counter()
+    train_als(
+        user_idx, item_idx, rating, num_users, num_items,
+        params=ALSParams(rank=10, reg=0.01, seed=3, num_iterations=1),
+        mesh=mesh,
+    )
+    warm_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    state = train_als(
+        user_idx, item_idx, rating, num_users, num_items,
+        params=params, mesh=mesh,
+    )
+    train_s = time.perf_counter() - t0
+    assert np.isfinite(np.asarray(state.user_factors)).all()
+
+    import sys
+
+    print(
+        f"# platform={platform} devices={n_dev} nnz={nnz} "
+        f"warmup(compile+1ep)={warm_s:.2f}s",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "als_ml20m_train_time"
+                if scale == 1.0
+                else f"als_ml20m_train_time_scale{scale:g}",
+                "value": round(train_s, 3),
+                "unit": "s",
+                "vs_baseline": round(budget_s / train_s, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+"""HTTP servers: event collection, prediction serving, admin, dashboard."""

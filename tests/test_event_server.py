@@ -1,0 +1,351 @@
+"""Route-level event server tests.
+
+The analog of the reference's akka-http route tests
+(data/src/test/scala/.../api/EventServiceSpec.scala:27): exercise the HTTPApp
+handler directly — no sockets — against real storage in a temp dir.
+"""
+
+import json
+
+import pytest
+
+from predictionio_tpu.data.storage.base import AccessKey, App, Channel
+from predictionio_tpu.server.event_server import create_event_server_app
+from predictionio_tpu.server.httpd import Request
+
+
+def make_req(method, path, query=None, body=None, headers=None):
+    raw = b""
+    if body is not None:
+        raw = json.dumps(body).encode() if not isinstance(body, bytes) else body
+    return Request(
+        method=method,
+        path=path,
+        query=query or {},
+        headers=headers or {},
+        body=raw,
+    )
+
+
+@pytest.fixture()
+def served(storage):
+    apps = storage.apps()
+    app_id = apps.insert(App(id=0, name="testapp", description=""))
+    storage.access_keys().insert(
+        AccessKey(key="SECRET", appid=app_id, events=[])
+    )
+    storage.access_keys().insert(
+        AccessKey(key="LIMITED", appid=app_id, events=["rate"])
+    )
+    storage.channels().insert(Channel(id=0, name="ch1", appid=app_id))
+    storage.l_events().init(app_id)
+    app = create_event_server_app(storage, stats=True)
+    return app, storage, app_id
+
+
+EVENT = {
+    "event": "rate",
+    "entityType": "user",
+    "entityId": "u1",
+    "targetEntityType": "item",
+    "targetEntityId": "i1",
+    "properties": {"rating": 4.0},
+    "eventTime": "2026-01-01T00:00:00.000Z",
+}
+
+
+class TestAuth:
+    def test_missing_key(self, served):
+        app, *_ = served
+        resp = app.handle(make_req("POST", "/events.json", body=EVENT))
+        assert resp.status == 401
+
+    def test_invalid_key(self, served):
+        app, *_ = served
+        resp = app.handle(
+            make_req("POST", "/events.json", {"accessKey": "nope"}, EVENT)
+        )
+        assert resp.status == 401
+
+    def test_basic_auth_header(self, served):
+        app, *_ = served
+        import base64
+
+        hdr = "Basic " + base64.b64encode(b"SECRET:").decode()
+        resp = app.handle(
+            make_req(
+                "POST", "/events.json", body=EVENT, headers={"Authorization": hdr}
+            )
+        )
+        assert resp.status == 201
+
+    def test_invalid_channel(self, served):
+        app, *_ = served
+        resp = app.handle(
+            make_req(
+                "POST",
+                "/events.json",
+                {"accessKey": "SECRET", "channel": "nope"},
+                EVENT,
+            )
+        )
+        assert resp.status == 401
+
+    def test_restricted_events(self, served):
+        app, *_ = served
+        bad = dict(EVENT, event="buy", targetEntityType=None, targetEntityId=None)
+        bad = {k: v for k, v in bad.items() if v is not None}
+        resp = app.handle(
+            make_req("POST", "/events.json", {"accessKey": "LIMITED"}, bad)
+        )
+        assert resp.status == 403
+
+
+class TestEventCrud:
+    def test_roundtrip(self, served):
+        app, *_ = served
+        q = {"accessKey": "SECRET"}
+        resp = app.handle(make_req("POST", "/events.json", q, EVENT))
+        assert resp.status == 201
+        event_id = json.loads(resp.encoded()[0])["eventId"]
+
+        resp = app.handle(make_req("GET", f"/events/{event_id}.json", q))
+        assert resp.status == 200
+        got = json.loads(resp.encoded()[0])
+        assert got["event"] == "rate" and got["entityId"] == "u1"
+
+        resp = app.handle(make_req("DELETE", f"/events/{event_id}.json", q))
+        assert resp.status == 200
+        resp = app.handle(make_req("GET", f"/events/{event_id}.json", q))
+        assert resp.status == 404
+
+    def test_channel_isolation(self, served):
+        app, *_ = served
+        resp = app.handle(
+            make_req(
+                "POST",
+                "/events.json",
+                {"accessKey": "SECRET", "channel": "ch1"},
+                EVENT,
+            )
+        )
+        assert resp.status == 201
+        # default channel sees nothing
+        resp = app.handle(make_req("GET", "/events.json", {"accessKey": "SECRET"}))
+        assert resp.status == 404
+        resp = app.handle(
+            make_req("GET", "/events.json", {"accessKey": "SECRET", "channel": "ch1"})
+        )
+        assert resp.status == 200
+
+    def test_malformed_event(self, served):
+        app, *_ = served
+        resp = app.handle(
+            make_req(
+                "POST",
+                "/events.json",
+                {"accessKey": "SECRET"},
+                {"event": "", "entityType": "user", "entityId": "u1"},
+            )
+        )
+        assert resp.status == 400
+
+    def test_query_filters(self, served):
+        app, *_ = served
+        q = {"accessKey": "SECRET"}
+        for i in range(5):
+            e = dict(EVENT, entityId=f"u{i}", eventTime=f"2026-01-0{i + 1}T00:00:00.000Z")
+            assert app.handle(make_req("POST", "/events.json", q, e)).status == 201
+        resp = app.handle(
+            make_req("GET", "/events.json", dict(q, entityId="u2", entityType="user"))
+        )
+        assert resp.status == 200
+        events = json.loads(resp.encoded()[0])
+        assert len(events) == 1 and events[0]["entityId"] == "u2"
+
+        resp = app.handle(
+            make_req(
+                "GET",
+                "/events.json",
+                dict(q, startTime="2026-01-03T00:00:00.000Z", limit="10"),
+            )
+        )
+        assert len(json.loads(resp.encoded()[0])) == 3
+
+        resp = app.handle(make_req("GET", "/events.json", dict(q, reversed="true")))
+        assert resp.status == 400  # reversed requires entityType+entityId
+
+
+class TestBatch:
+    def test_batch_mixed(self, served):
+        app, *_ = served
+        batch = [
+            EVENT,
+            {"event": "", "entityType": "user", "entityId": "x"},  # invalid
+            dict(EVENT, entityId="u9"),
+        ]
+        resp = app.handle(
+            make_req("POST", "/batch/events.json", {"accessKey": "SECRET"}, batch)
+        )
+        assert resp.status == 200
+        results = json.loads(resp.encoded()[0])
+        assert [r["status"] for r in results] == [201, 400, 201]
+
+    def test_batch_cap(self, served):
+        app, *_ = served
+        batch = [EVENT] * 51
+        resp = app.handle(
+            make_req("POST", "/batch/events.json", {"accessKey": "SECRET"}, batch)
+        )
+        assert resp.status == 400
+
+
+class TestStats:
+    def test_stats_counts(self, served):
+        app, *_ = served
+        q = {"accessKey": "SECRET"}
+        app.handle(make_req("POST", "/events.json", q, EVENT))
+        app.handle(make_req("POST", "/events.json", q, EVENT))
+        resp = app.handle(make_req("GET", "/stats.json", q))
+        assert resp.status == 200
+        snap = json.loads(resp.encoded()[0])["currentHour"]
+        assert snap["basic"][0]["count"] == 2
+        assert snap["statusCode"][0] == {"status": 201, "count": 2}
+
+
+class TestWebhooks:
+    def test_segmentio_track(self, served):
+        app, storage, app_id = served
+        payload = {
+            "version": "2",
+            "type": "track",
+            "userId": "user42",
+            "event": "Signed Up",
+            "properties": {"plan": "Pro"},
+            "timestamp": "2026-01-05T10:00:00.000Z",
+        }
+        resp = app.handle(
+            make_req(
+                "POST", "/webhooks/segmentio.json", {"accessKey": "SECRET"}, payload
+            )
+        )
+        assert resp.status == 201
+        events = list(storage.l_events().find(app_id))
+        assert events[0].event == "track"
+        assert events[0].entity_id == "user42"
+        assert events[0].properties.get("event") == "Signed Up"
+
+    def test_segmentio_unknown_type(self, served):
+        app, *_ = served
+        resp = app.handle(
+            make_req(
+                "POST",
+                "/webhooks/segmentio.json",
+                {"accessKey": "SECRET"},
+                {"version": "2", "type": "frobnicate", "userId": "u"},
+            )
+        )
+        assert resp.status == 400
+
+    def test_unsupported_connector(self, served):
+        app, *_ = served
+        resp = app.handle(
+            make_req(
+                "POST", "/webhooks/nope.json", {"accessKey": "SECRET"}, {"a": 1}
+            )
+        )
+        assert resp.status == 404
+
+    def test_mailchimp_subscribe_form(self, served):
+        app, storage, app_id = served
+        from urllib.parse import urlencode
+
+        form = {
+            "type": "subscribe",
+            "fired_at": "2026-03-26 21:35:57",
+            "data[id]": "8a25ff1d98",
+            "data[list_id]": "a6b5da1054",
+            "data[email]": "api@example.com",
+            "data[email_type]": "html",
+            "data[merges][EMAIL]": "api@example.com",
+            "data[merges][FNAME]": "Mail",
+            "data[ip_opt]": "10.20.10.30",
+            "data[ip_signup]": "10.20.10.30",
+        }
+        resp = app.handle(
+            make_req(
+                "POST",
+                "/webhooks/mailchimp.form",
+                {"accessKey": "SECRET"},
+                urlencode(form).encode(),
+            )
+        )
+        assert resp.status == 201
+        (e,) = storage.l_events().find(app_id)
+        assert e.event == "subscribe"
+        assert e.entity_id == "8a25ff1d98"
+        assert e.target_entity_id == "a6b5da1054"
+        assert e.properties.get("merges")["FNAME"] == "Mail"
+
+
+def test_server_binds_and_serves(served):
+    """One socket-level smoke test (AppServer thread + real HTTP)."""
+    import urllib.request
+
+    from predictionio_tpu.server.httpd import AppServer
+
+    app, *_ = served
+    server = AppServer(app, host="127.0.0.1", port=0).start_background()
+    try:
+        url = f"http://127.0.0.1:{server.port}/"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            assert json.loads(r.read())["status"] == "alive"
+        data = json.dumps(EVENT).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/events.json?accessKey=SECRET",
+            data=data,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 201
+    finally:
+        server.shutdown()
+
+
+class TestReviewRegressions:
+    """Fixes from review: mixed-target stats sort, bad fired_at, encoded ids."""
+
+    def test_stats_mixed_target_types(self, served):
+        app, *_ = served
+        q = {"accessKey": "SECRET"}
+        app.handle(make_req("POST", "/events.json", q, EVENT))
+        untargeted = {
+            "event": "$set",
+            "entityType": "user",
+            "entityId": "u1",
+            "properties": {"a": 1},
+        }
+        app.handle(make_req("POST", "/events.json", q, untargeted))
+        resp = app.handle(make_req("GET", "/stats.json", q))
+        assert resp.status == 200
+        assert len(json.loads(resp.encoded()[0])["currentHour"]["basic"]) == 2
+
+    def test_mailchimp_bad_fired_at(self, served):
+        app, *_ = served
+        from urllib.parse import urlencode
+
+        form = {
+            "type": "subscribe",
+            "fired_at": "2026-03-26T21:35:57",  # ISO 'T', not MailChimp format
+            "data[id]": "x",
+            "data[list_id]": "y",
+        }
+        resp = app.handle(
+            make_req(
+                "POST",
+                "/webhooks/mailchimp.form",
+                {"accessKey": "SECRET"},
+                urlencode(form).encode(),
+            )
+        )
+        assert resp.status == 400
