@@ -1,0 +1,46 @@
+"""Evaluation binding: engine + engine-params sweep + metrics.
+
+The controller/Evaluation.scala:34-124 analog: an ``Evaluation`` names the
+engine (factory), the list of EngineParams to sweep, and the metric(s); the
+CLI's ``eval`` verb imports one by path
+(``pkg.module:evaluation_object``) and hands it to ``run_evaluation`` —
+the reference's `pio eval <Evaluation> <EngineParamsGenerator>` collapses to
+one object because params generators are plain lists/functions here
+(EngineParamsGenerator.scala:30).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+from predictionio_tpu.core.engine import Engine, EngineParams
+from predictionio_tpu.core.metric import Metric
+
+
+@dataclass
+class Evaluation:
+    """Bind an engine factory to a params sweep and metrics."""
+
+    engine_factory: Callable[[], Engine]
+    engine_params_list: Sequence[EngineParams] | Callable[[], Sequence[EngineParams]]
+    metric: Metric
+    other_metrics: Sequence[Metric] = field(default_factory=tuple)
+
+    def params_list(self) -> Sequence[EngineParams]:
+        eps = self.engine_params_list
+        return list(eps()) if callable(eps) else list(eps)
+
+
+def resolve_evaluation(path: str) -> Evaluation:
+    """Import an Evaluation by ``pkg.module:attr`` path."""
+    from predictionio_tpu.utils.registry import resolve_import_path
+
+    obj = resolve_import_path(path)
+    if obj is None:
+        raise KeyError(f"evaluation {path!r} not found")
+    if callable(obj) and not isinstance(obj, Evaluation):
+        obj = obj()
+    if not isinstance(obj, Evaluation):
+        raise TypeError(f"{path!r} did not resolve to an Evaluation")
+    return obj

@@ -6,4 +6,9 @@ Parity targets (reference examples/):
   - classification: Naive Bayes / logistic regression (scala-parallel-classification)
   - ecommerce: ALS + business-rule filters (scala-parallel-ecommercerecommendation)
   - ncf: deep two-tower/NCF with sharded embeddings (pypio deep-rec config)
+
+Importing this package registers every bundled engine factory (the reflective
+EngineFactory discovery analog, workflow/WorkflowUtils.scala:47).
 """
+
+from predictionio_tpu.models import recommendation  # noqa: F401

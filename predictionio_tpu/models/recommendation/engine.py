@@ -245,6 +245,7 @@ class ALSAlgorithm(Algorithm):
 
     flavor = "P2L"
     params_class = ALSAlgorithmParams
+    query_class = Query
 
     def __init__(self, params: ALSAlgorithmParams | None = None):
         self.params = params or ALSAlgorithmParams()

@@ -1,0 +1,97 @@
+"""Admin REST API (:7071) — app/access-key management over HTTP.
+
+Route parity with tools/admin/AdminAPI.scala:45-109 + CommandClient.scala:61:
+
+  GET    /                      {"status": "alive"}
+  GET    /cmd/app               list apps
+  POST   /cmd/app               create app {"name": ..., ["description"]}
+  DELETE /cmd/app/<name>        delete app + keys + events
+  GET    /cmd/app/<name>        show app
+  DELETE /cmd/app/<name>/data   wipe the app's events
+"""
+
+from __future__ import annotations
+
+from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
+from predictionio_tpu.server.httpd import (
+    AppServer,
+    HTTPApp,
+    Request,
+    Response,
+    error_response,
+    json_response,
+)
+from predictionio_tpu.tools.commands import (
+    AppDescription,
+    CommandError,
+    app_data_delete,
+    app_delete,
+    app_list,
+    app_new,
+    app_show,
+)
+
+
+def create_admin_app(storage: StorageRuntime | None = None) -> HTTPApp:
+    storage = storage or get_storage()
+    app = HTTPApp("adminserver")
+
+    def describe(d: AppDescription) -> dict:
+        return d.to_json_dict()
+
+    @app.route("GET", "/")
+    def index(req: Request) -> Response:
+        return json_response(200, {"status": "alive"})
+
+    @app.route("GET", "/cmd/app")
+    def list_apps(req: Request) -> Response:
+        return json_response(200, [describe(d) for d in app_list(storage)])
+
+    @app.route("POST", "/cmd/app")
+    def new_app(req: Request) -> Response:
+        try:
+            payload = req.json() or {}
+            name = payload["name"]
+        except Exception:
+            return error_response(400, "body must be JSON with a 'name' field")
+        try:
+            d = app_new(
+                storage,
+                name,
+                description=payload.get("description", ""),
+                access_key=payload.get("accessKey"),
+            )
+        except CommandError as e:
+            return error_response(409, str(e))
+        return json_response(201, describe(d))
+
+    @app.route("GET", "/cmd/app/(?P<name>[^/]+)")
+    def show_app(req: Request) -> Response:
+        try:
+            return json_response(200, describe(app_show(storage, req.params["name"])))
+        except CommandError as e:
+            return error_response(404, str(e))
+
+    @app.route("DELETE", "/cmd/app/(?P<name>[^/]+)")
+    def delete_app(req: Request) -> Response:
+        try:
+            app_delete(storage, req.params["name"])
+        except CommandError as e:
+            return error_response(404, str(e))
+        return json_response(200, {"message": f"App {req.params['name']} deleted"})
+
+    @app.route("DELETE", "/cmd/app/(?P<name>[^/]+)/data")
+    def delete_data(req: Request) -> Response:
+        try:
+            app_data_delete(storage, req.params["name"])
+        except CommandError as e:
+            return error_response(404, str(e))
+        return json_response(200, {"message": "Data deleted"})
+
+    return app
+
+
+def create_admin_server(
+    host: str = "0.0.0.0", port: int = 7071, storage: StorageRuntime | None = None
+) -> AppServer:
+    return AppServer(create_admin_app(storage), host, port)

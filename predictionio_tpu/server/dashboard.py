@@ -1,0 +1,76 @@
+"""Evaluation dashboard (:9000).
+
+Parity with tools/dashboard/Dashboard.scala:47-120: an HTML index of
+completed evaluations (newest first) with their params and metric scores, and
+a per-instance detail page rendering the evaluator's stored HTML
+(CoreWorkflow persists one-liner/HTML/JSON results onto the
+EvaluationInstance row, CoreWorkflow.scala:144-155).
+"""
+
+from __future__ import annotations
+
+import html
+
+from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
+from predictionio_tpu.server.httpd import (
+    AppServer,
+    HTTPApp,
+    Request,
+    Response,
+    error_response,
+)
+
+
+def create_dashboard_app(storage: StorageRuntime | None = None) -> HTTPApp:
+    storage = storage or get_storage()
+    app = HTTPApp("dashboard")
+
+    @app.route("GET", "/")
+    def index(req: Request) -> Response:
+        instances = storage.evaluation_instances().get_completed()
+        rows = "".join(
+            f"<tr><td><a href='/engine_instances/{html.escape(i.id)}'>"
+            f"{html.escape(i.id)}</a></td>"
+            f"<td>{html.escape(i.evaluation_class)}</td>"
+            f"<td>{html.escape(i.start_time.isoformat())}</td>"
+            f"<td>{html.escape(i.end_time.isoformat())}</td>"
+            f"<td>{html.escape(i.evaluator_results or '')}</td></tr>"
+            for i in instances
+        )
+        return Response(
+            200,
+            "<html><head><title>PredictionIO-TPU Dashboard</title></head><body>"
+            "<h1>Completed evaluations</h1>"
+            "<table border='1'><tr><th>id</th><th>evaluation</th>"
+            f"<th>started</th><th>finished</th><th>result</th></tr>{rows}"
+            "</table></body></html>",
+        )
+
+    @app.route("GET", "/engine_instances/(?P<iid>[^/]+)")
+    def detail(req: Request) -> Response:
+        inst = storage.evaluation_instances().get(req.params["iid"])
+        if inst is None:
+            return error_response(404, "Not Found")
+        return Response(
+            200,
+            f"<html><body><h1>Evaluation {html.escape(inst.id)}</h1>"
+            f"{inst.evaluator_results_html or '<p>(no results)</p>'}"
+            "</body></html>",
+        )
+
+    @app.route("GET", "/engine_instances/(?P<iid>[^/]+)/evaluator_results\\.json")
+    def detail_json(req: Request) -> Response:
+        inst = storage.evaluation_instances().get(req.params["iid"])
+        if inst is None:
+            return error_response(404, "Not Found")
+        return Response(
+            200, inst.evaluator_results_json or "{}", content_type="application/json"
+        )
+
+    return app
+
+
+def create_dashboard_server(
+    host: str = "0.0.0.0", port: int = 9000, storage: StorageRuntime | None = None
+) -> AppServer:
+    return AppServer(create_dashboard_app(storage), host, port)

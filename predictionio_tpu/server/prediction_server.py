@@ -1,0 +1,361 @@
+"""Prediction serving (:8000) — the `pio deploy` server.
+
+Route parity with workflow/CreateServer.scala:458-706:
+
+  GET  /              HTML status page (engine info, request count,
+                      avg/last serving seconds — CreateServer.scala:415-417)
+  POST /queries.json  the hot path (:484): extract query -> supplement ->
+                      predict per algorithm -> serve -> optional feedback
+                      event -> JSON
+  POST /reload        hot-swap to the latest COMPLETED engine instance (:635)
+  POST /stop          shut the server down (:643, key-authenticated when an
+                      access key is configured)
+
+Where the reference re-trains Unit-persisted models at deploy
+(Engine.prepareDeploy:210-232), models here always persist as pytrees and
+``load_persistent_model`` re-materializes device arrays — the factors land
+TPU-resident once at bind time, and every query runs a jit-compiled scoring
+program against them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import secrets
+import threading
+import time
+from dataclasses import dataclass
+from datetime import datetime, timezone
+from typing import Any, Callable
+
+from predictionio_tpu.core.base import EngineContext
+from predictionio_tpu.core.engine import Engine, resolve_engine_factory
+from predictionio_tpu.core.persistence import deserialize_models
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import EngineInstance
+from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
+from predictionio_tpu.server.httpd import (
+    AppServer,
+    HTTPApp,
+    Request,
+    Response,
+    error_response,
+    json_response,
+)
+from predictionio_tpu.utils.params import extract_params
+
+log = logging.getLogger("predictionio_tpu.serving")
+
+
+def _render_prediction(p: Any) -> Any:
+    if hasattr(p, "to_json_dict"):
+        return p.to_json_dict()
+    if dataclasses.is_dataclass(p) and not isinstance(p, type):
+        return dataclasses.asdict(p)
+    return p
+
+
+def _extract_query(algorithms, payload: dict) -> Any:
+    """JsonExtractor role for queries: the first algorithm's declared
+    ``query_class`` (BaseAlgorithm.queryClass:118) drives dataclass
+    extraction; engines without one get the raw dict."""
+    qcls = next(
+        (a.query_class for a in algorithms if getattr(a, "query_class", None)),
+        None,
+    )
+    if qcls is None:
+        return payload
+    return extract_params(qcls, payload)
+
+
+@dataclass
+class FeedbackConfig:
+    """Loop predictions back into the event store (CreateServer.scala:527-589).
+
+    The reference POSTs to the event server over HTTP with an access key; the
+    single-VM default here writes through the storage layer directly, keyed by
+    app id (resolved from the access key when given).
+    """
+
+    enabled: bool = False
+    app_id: int | None = None
+    access_key: str | None = None
+    channel_id: int | None = None
+
+
+class DeployedEngine:
+    """Engine + materialized models for one engine instance, hot-swappable."""
+
+    def __init__(
+        self,
+        engine: Engine,
+        instance: EngineInstance,
+        storage: StorageRuntime,
+        ctx: EngineContext | None = None,
+    ):
+        self.engine = engine
+        self.storage = storage
+        self.ctx = ctx or EngineContext(storage=storage, mode="serving")
+        self._lock = threading.RLock()
+        self._bind(instance)
+
+    def _bind(self, instance: EngineInstance) -> None:
+        params = self.engine.params_from_json(_instance_variant(instance))
+        blob = self.storage.models().get(instance.id)
+        if blob is None:
+            raise RuntimeError(
+                f"no model blob for engine instance {instance.id}"
+            )
+        persisted = deserialize_models(blob)
+        models = self.engine.prepare_deploy(self.ctx, params, persisted)
+        _, _, algos, serving = self.engine.instantiate(params)
+        with self._lock:
+            self.instance = instance
+            self.params = params
+            self.algorithms = algos
+            self.models = models
+            self.serving = serving
+
+    def reload_latest(self) -> EngineInstance:
+        """Re-bind to the latest COMPLETED instance (MasterActor ReloadServer)."""
+        latest = self.storage.engine_instances().get_latest_completed(
+            self.instance.engine_id,
+            self.instance.engine_version,
+            self.instance.engine_variant,
+        )
+        if latest is None:
+            raise RuntimeError("no COMPLETED engine instance to reload")
+        self._bind(latest)
+        return latest
+
+    def extract_query(self, query_payload: dict) -> Any:
+        with self._lock:
+            algorithms = self.algorithms
+        return _extract_query(algorithms, query_payload)
+
+    def predict(self, query: Any) -> tuple[Any, Any]:
+        with self._lock:
+            algorithms, models, serving = self.algorithms, self.models, self.serving
+        query = serving.supplement(query)
+        predictions = [
+            a.predict(m, query) for a, m in zip(algorithms, models)
+        ]
+        return query, serving.serve(query, predictions)
+
+
+# The engine-params JSON shape stored on EngineInstance rows round-trips
+# through params_from_json; reconstructing needs the name-keyed dicts.
+def _instance_variant(instance: EngineInstance) -> dict[str, Any]:
+    def one(raw: str) -> dict[str, Any]:
+        d = json.loads(raw or "{}")
+        if not d:
+            return {}
+        ((name, params),) = d.items()
+        return {"name": name, "params": params}
+
+    return {
+        "datasource": one(instance.datasource_params),
+        "preparator": one(instance.preparator_params),
+        "algorithms": [
+            {"name": name, "params": p}
+            for entry in json.loads(instance.algorithms_params or "[]")
+            for name, p in entry.items()
+        ],
+        "serving": one(instance.serving_params),
+    }
+
+
+def create_prediction_server_app(
+    deployed: DeployedEngine,
+    feedback: FeedbackConfig | None = None,
+    on_stop: Callable[[], None] | None = None,
+    access_key: str | None = None,
+) -> HTTPApp:
+    app = HTTPApp("predictionserver")
+    feedback = feedback or FeedbackConfig()
+    stats = {"request_count": 0, "avg_serving_sec": 0.0, "last_serving_sec": 0.0}
+    stats_lock = threading.Lock()
+    started_at = datetime.now(tz=timezone.utc)
+
+    if feedback.enabled and feedback.app_id is None:
+        if not feedback.access_key:
+            raise RuntimeError(
+                "feedback requires an app_id or access_key to route events"
+            )
+        k = deployed.storage.access_keys().get(feedback.access_key)
+        if k is None:
+            raise RuntimeError("feedback access key is invalid")
+        feedback.app_id = k.appid
+
+    def _feedback_event(query: Any, rendered_prediction: Any) -> None:
+        pr_id = secrets.token_hex(32)
+        ev = Event(
+            event="predict",
+            entity_type="pio_pr",
+            entity_id=pr_id,
+            properties=DataMap(
+                {
+                    "engineInstanceId": deployed.instance.id,
+                    "query": _render_prediction(query),
+                    "prediction": rendered_prediction,
+                }
+            ),
+        )
+        deployed.storage.l_events().insert(
+            ev, feedback.app_id, feedback.channel_id
+        )
+
+    @app.route("GET", "/")
+    def index(req: Request) -> Response:
+        inst = deployed.instance
+        body = f"""<html><head><title>PredictionIO-TPU server</title></head>
+<body>
+<h1>Engine is deployed and running</h1>
+<table>
+<tr><td>Engine instance</td><td>{inst.id}</td></tr>
+<tr><td>Engine</td><td>{inst.engine_factory or inst.engine_id}</td></tr>
+<tr><td>Variant</td><td>{inst.engine_variant}</td></tr>
+<tr><td>Started</td><td>{started_at.isoformat()}</td></tr>
+<tr><td>Requests</td><td>{stats['request_count']}</td></tr>
+<tr><td>Average serving (s)</td><td>{stats['avg_serving_sec']:.6f}</td></tr>
+<tr><td>Last serving (s)</td><td>{stats['last_serving_sec']:.6f}</td></tr>
+</table>
+</body></html>"""
+        return Response(200, body)
+
+    @app.route("GET", "/status\\.json")
+    def status(req: Request) -> Response:
+        return json_response(
+            200,
+            {
+                "status": "alive",
+                "engineInstanceId": deployed.instance.id,
+                "startTime": started_at.isoformat(),
+                **stats,
+            },
+        )
+
+    @app.route("POST", "/queries\\.json")
+    def queries(req: Request) -> Response:
+        t0 = time.perf_counter()
+        # bad query JSON/shape -> 400; engine/server faults -> logged 500
+        # (the reference's MappingException / Throwable split,
+        # CreateServer.scala:607-630)
+        try:
+            payload = req.json()
+            if not isinstance(payload, dict):
+                raise ValueError("query must be a JSON object")
+            query = deployed.extract_query(payload)
+        except Exception as e:
+            return error_response(400, f"invalid query: {e}")
+        try:
+            query, prediction = deployed.predict(query)
+        except Exception as e:
+            log.exception("query serving failed")
+            return error_response(500, f"{type(e).__name__}: {e}")
+        rendered = _render_prediction(prediction)
+        if feedback.enabled and feedback.app_id is not None:
+            try:
+                _feedback_event(query, rendered)
+            except Exception as e:  # feedback must never fail the query
+                log.error("feedback event failed: %s", e)
+        dt = time.perf_counter() - t0
+        with stats_lock:
+            n = stats["request_count"]
+            stats["avg_serving_sec"] = (stats["avg_serving_sec"] * n + dt) / (n + 1)
+            stats["last_serving_sec"] = dt
+            stats["request_count"] = n + 1
+        return json_response(200, rendered)
+
+    def _authorized(req: Request) -> bool:
+        return access_key is None or req.query.get("accessKey") == access_key
+
+    @app.route("POST", "/reload")
+    def reload(req: Request) -> Response:
+        if not _authorized(req):
+            return error_response(401, "Invalid accessKey.")
+        inst = deployed.reload_latest()
+        return json_response(200, {"message": "Reloaded", "engineInstanceId": inst.id})
+
+    @app.route("POST", "/stop")
+    def stop(req: Request) -> Response:
+        if not _authorized(req):
+            return error_response(401, "Invalid accessKey.")
+        if on_stop is not None:
+            threading.Thread(target=on_stop, daemon=True).start()
+        return json_response(200, {"message": "Shutting down."})
+
+    return app
+
+
+def deploy_engine(
+    engine_factory_name: str,
+    storage: StorageRuntime | None = None,
+    engine_instance_id: str | None = None,
+    engine_id: str = "default",
+    engine_version: str = "default",
+    engine_variant: str = "default",
+) -> DeployedEngine:
+    """Resolve factory + engine instance and materialize models for serving.
+
+    Mirrors CreateServer.createPredictionServerWithEngine:193: given an
+    explicit instance id or the latest COMPLETED one for
+    (engine_id, version, variant).
+    """
+    storage = storage or get_storage()
+    instances = storage.engine_instances()
+    if engine_instance_id is not None:
+        instance = instances.get(engine_instance_id)
+        if instance is None:
+            raise RuntimeError(f"engine instance {engine_instance_id} not found")
+    else:
+        instance = instances.get_latest_completed(
+            engine_id, engine_version, engine_variant
+        )
+        if instance is None:
+            raise RuntimeError(
+                f"no COMPLETED engine instance for engine {engine_id!r}; "
+                "run train first"
+            )
+    factory = resolve_engine_factory(
+        engine_factory_name or instance.engine_factory
+    )
+    engine = factory()
+    return DeployedEngine(engine, instance, storage)
+
+
+def create_prediction_server(
+    engine_factory_name: str,
+    host: str = "0.0.0.0",
+    port: int = 8000,
+    storage: StorageRuntime | None = None,
+    engine_instance_id: str | None = None,
+    engine_id: str = "default",
+    engine_version: str = "default",
+    engine_variant: str = "default",
+    feedback: FeedbackConfig | None = None,
+    access_key: str | None = None,
+) -> AppServer:
+    deployed = deploy_engine(
+        engine_factory_name,
+        storage=storage,
+        engine_instance_id=engine_instance_id,
+        engine_id=engine_id,
+        engine_version=engine_version,
+        engine_variant=engine_variant,
+    )
+    server_ref: list[AppServer] = []
+
+    def on_stop():
+        if server_ref:
+            server_ref[0].shutdown()
+
+    app = create_prediction_server_app(
+        deployed, feedback=feedback, on_stop=on_stop, access_key=access_key
+    )
+    server = AppServer(app, host, port)
+    server_ref.append(server)
+    return server

@@ -1,0 +1,1 @@
+"""CLI and operational tooling (the reference's `tools/` layer)."""

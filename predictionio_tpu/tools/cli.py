@@ -1,0 +1,486 @@
+"""The `pio` console (tools/console/Console.scala:134-623, Pio.scala:51-180).
+
+Every verb runs in-process on the TPU VM — there is no spark-submit hop
+(Runner.scala:185's role collapses to a function call; multi-host launches
+use `jax.distributed` env bootstrap instead, parallel/mesh.py).
+
+Usage examples:
+  python -m predictionio_tpu.tools.cli app new myapp
+  python -m predictionio_tpu.tools.cli import --app myapp --input events.jsonl
+  python -m predictionio_tpu.tools.cli train --engine recommendation \
+      --engine-json engine.json
+  python -m predictionio_tpu.tools.cli deploy --engine recommendation --port 8000
+  python -m predictionio_tpu.tools.cli eval my_pkg.my_eval:evaluation
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Any
+
+from predictionio_tpu.data.storage.config import get_storage
+from predictionio_tpu.tools import commands as cmd
+from predictionio_tpu.tools.commands import CommandError
+from predictionio_tpu.version import __version__
+
+
+def _load_engine_modules() -> None:
+    """Import bundled template modules so their factories register."""
+    import predictionio_tpu.models  # noqa: F401
+
+
+def _resolve_engine(args) -> tuple[str, Any, dict]:
+    """(factory_name, Engine, variant_json) from --engine/--engine-json."""
+    from predictionio_tpu.core.engine import resolve_engine_factory
+
+    _load_engine_modules()
+    variant: dict = {}
+    variant_path = getattr(args, "engine_json", None)
+    if variant_path and Path(variant_path).exists():
+        variant = json.loads(Path(variant_path).read_text())
+    factory_name = getattr(args, "engine", None) or variant.get("engineFactory")
+    if not factory_name:
+        raise CommandError(
+            "no engine specified: pass --engine NAME or an engine.json with "
+            "an 'engineFactory' field"
+        )
+    engine = resolve_engine_factory(factory_name)()
+    return factory_name, engine, variant
+
+
+def _print(obj: Any) -> None:
+    print(json.dumps(obj, indent=2, default=str))
+
+
+def _describe(d: cmd.AppDescription) -> dict:
+    return d.to_json_dict()
+
+
+# -- verb implementations ---------------------------------------------------
+
+
+def do_version(args) -> int:
+    print(__version__)
+    return 0
+
+
+def do_status(args) -> int:
+    """`pio status` (commands/Management.scala): storage connectivity probe."""
+    storage = get_storage()
+    import jax
+
+    checks = storage.verify_all_data_objects()
+    _print(
+        {
+            "version": __version__,
+            "storage": checks,
+            "devices": [str(d) for d in jax.devices()],
+            "backend": jax.default_backend(),
+        }
+    )
+    return 0 if all(checks.values()) else 1
+
+
+def do_app(args) -> int:
+    storage = get_storage()
+    if args.app_command == "new":
+        d = cmd.app_new(
+            storage, args.name, description=args.description or "",
+            access_key=args.access_key,
+        )
+        _print(_describe(d))
+    elif args.app_command == "list":
+        _print([_describe(d) for d in cmd.app_list(storage)])
+    elif args.app_command == "show":
+        _print(_describe(cmd.app_show(storage, args.name)))
+    elif args.app_command == "delete":
+        cmd.app_delete(storage, args.name)
+        print(f"App {args.name} deleted.")
+    elif args.app_command == "data-delete":
+        cmd.app_data_delete(storage, args.name, channel=args.channel)
+        print(f"Data of app {args.name} deleted.")
+    elif args.app_command == "channel-new":
+        ch = cmd.channel_new(storage, args.name, args.channel)
+        _print({"id": ch.id, "name": ch.name, "appid": ch.appid})
+    elif args.app_command == "channel-delete":
+        cmd.channel_delete(storage, args.name, args.channel)
+        print(f"Channel {args.channel} deleted.")
+    return 0
+
+
+def do_accesskey(args) -> int:
+    storage = get_storage()
+    if args.ak_command == "new":
+        k = cmd.accesskey_new(
+            storage, args.app, key=args.key, events=args.event or []
+        )
+        _print({"key": k.key, "appid": k.appid, "events": list(k.events)})
+    elif args.ak_command == "list":
+        _print(
+            [
+                {"key": k.key, "appid": k.appid, "events": list(k.events)}
+                for k in cmd.accesskey_list(storage, args.app)
+            ]
+        )
+    elif args.ak_command == "delete":
+        cmd.accesskey_delete(storage, args.key)
+        print(f"Access key {args.key} deleted.")
+    return 0
+
+
+def do_import(args) -> int:
+    n = cmd.import_events(
+        get_storage(), args.app, args.input, channel=args.channel
+    )
+    print(f"Imported {n} events.")
+    return 0
+
+
+def do_export(args) -> int:
+    n = cmd.export_events(
+        get_storage(), args.app, args.output, channel=args.channel
+    )
+    print(f"Exported {n} events.")
+    return 0
+
+
+def do_train(args) -> int:
+    from predictionio_tpu.core.base import EngineContext
+    from predictionio_tpu.core.workflow import WorkflowParams, run_train
+    from predictionio_tpu.parallel.mesh import MeshConfig, initialize_distributed
+
+    initialize_distributed()
+    factory_name, engine, variant = _resolve_engine(args)
+    params = engine.params_from_json(variant)
+    ctx = EngineContext(
+        mesh_config=MeshConfig.from_dict(variant.get("mesh")),
+        storage=get_storage(),
+        mode="train",
+    )
+    instance = run_train(
+        engine,
+        params,
+        ctx=ctx,
+        workflow_params=WorkflowParams(
+            batch=args.batch or "",
+            skip_sanity_check=args.skip_sanity_check,
+            stop_after_read=args.stop_after_read,
+            stop_after_prepare=args.stop_after_prepare,
+        ),
+        engine_id=variant.get("id", args.engine_id),
+        engine_version=variant.get("version", args.engine_version),
+        engine_variant=variant.get("variant", args.variant),
+        engine_factory=factory_name,
+    )
+    if instance is not None:
+        print(f"Training completed. Engine instance: {instance.id}")
+    return 0
+
+
+def do_eval(args) -> int:
+    from predictionio_tpu.core.base import EngineContext
+    from predictionio_tpu.core.workflow import run_evaluation
+    from predictionio_tpu.eval.evaluation import resolve_evaluation
+    from predictionio_tpu.eval.evaluator import MetricEvaluator
+
+    _load_engine_modules()
+    evaluation = resolve_evaluation(args.evaluation)
+    engine = evaluation.engine_factory()
+    result = run_evaluation(
+        engine,
+        evaluation.params_list(),
+        MetricEvaluator(evaluation.metric, evaluation.other_metrics),
+        ctx=EngineContext(storage=get_storage(), mode="eval"),
+        evaluation_class=args.evaluation,
+    )
+    print(result.one_liner())
+    best = result.best()
+    print(f"Best score: {best.score}")
+    return 0
+
+
+def _engine_coords(args) -> tuple[str, str, str, str]:
+    """(factory, engine_id, version, variant) honoring --engine-json overrides."""
+    variant: dict = {}
+    if getattr(args, "engine_json", None) and Path(args.engine_json).exists():
+        variant = json.loads(Path(args.engine_json).read_text())
+    return (
+        args.engine or variant.get("engineFactory") or "",
+        variant.get("id", args.engine_id),
+        variant.get("version", args.engine_version),
+        variant.get("variant", args.variant),
+    )
+
+
+def do_deploy(args) -> int:
+    from predictionio_tpu.server.prediction_server import (
+        FeedbackConfig,
+        create_prediction_server,
+    )
+
+    _load_engine_modules()
+    factory, engine_id, engine_version, engine_variant = _engine_coords(args)
+    server = create_prediction_server(
+        factory,
+        host=args.ip,
+        port=args.port,
+        storage=get_storage(),
+        engine_instance_id=args.engine_instance_id,
+        engine_id=engine_id,
+        engine_version=engine_version,
+        engine_variant=engine_variant,
+        feedback=FeedbackConfig(
+            enabled=args.feedback, access_key=args.accesskey or None
+        ),
+        access_key=args.accesskey or None,
+    )
+    print(f"Serving on http://{args.ip}:{server.port} (POST /queries.json)")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+def do_undeploy(args) -> int:
+    import urllib.request
+
+    url = f"http://{args.ip}:{args.port}/stop"
+    if args.accesskey:
+        url += f"?accessKey={args.accesskey}"
+    try:
+        with urllib.request.urlopen(
+            urllib.request.Request(url, method="POST"), timeout=10
+        ) as r:
+            print(r.read().decode())
+        return 0
+    except Exception as e:
+        print(f"undeploy failed: {e}", file=sys.stderr)
+        return 1
+
+
+def do_batchpredict(args) -> int:
+    from predictionio_tpu.core.batch_predict import run_batch_predict
+
+    _load_engine_modules()
+    factory, engine_id, engine_version, engine_variant = _engine_coords(args)
+    n = run_batch_predict(
+        factory,
+        args.input,
+        args.output,
+        storage=get_storage(),
+        engine_instance_id=args.engine_instance_id,
+        engine_id=engine_id,
+        engine_version=engine_version,
+        engine_variant=engine_variant,
+    )
+    print(f"Wrote {n} predictions to {args.output}")
+    return 0
+
+
+def do_eventserver(args) -> int:
+    from predictionio_tpu.server.event_server import create_event_server
+
+    server = create_event_server(
+        host=args.ip, port=args.port, storage=get_storage(), stats=args.stats
+    )
+    print(f"Event server on http://{args.ip}:{server.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+def do_adminserver(args) -> int:
+    from predictionio_tpu.server.admin import create_admin_server
+
+    server = create_admin_server(host=args.ip, port=args.port, storage=get_storage())
+    print(f"Admin server on http://{args.ip}:{server.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+def do_dashboard(args) -> int:
+    from predictionio_tpu.server.dashboard import create_dashboard_server
+
+    server = create_dashboard_server(
+        host=args.ip, port=args.port, storage=get_storage()
+    )
+    print(f"Dashboard on http://{args.ip}:{server.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+def do_run(args) -> int:
+    """`pio run`: execute a user script with the framework importable
+    (Console.scala:333's arbitrary-main-class analog)."""
+    import runpy
+
+    sys.argv = [args.script] + (args.script_args or [])
+    runpy.run_path(args.script, run_name="__main__")
+    return 0
+
+
+def do_template(args) -> int:
+    """`pio template list`: bundled engine templates (Template.scala:35)."""
+    from predictionio_tpu.core.engine import engine_registry
+
+    _load_engine_modules()
+    _print(
+        {
+            "bundled": engine_registry.names(),
+            "note": "use --engine <name> with train/deploy, or an import "
+            "path 'pkg.module:factory' for custom engines",
+        }
+    )
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pio",
+        description="PredictionIO-TPU console — TPU-native ML serving framework",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("version").set_defaults(fn=do_version)
+    sub.add_parser("status").set_defaults(fn=do_status)
+
+    ap = sub.add_parser("app")
+    asub = ap.add_subparsers(dest="app_command", required=True)
+    new = asub.add_parser("new")
+    new.add_argument("name")
+    new.add_argument("--description")
+    new.add_argument("--access-key")
+    asub.add_parser("list")
+    show = asub.add_parser("show")
+    show.add_argument("name")
+    dele = asub.add_parser("delete")
+    dele.add_argument("name")
+    dd = asub.add_parser("data-delete")
+    dd.add_argument("name")
+    dd.add_argument("--channel")
+    cn = asub.add_parser("channel-new")
+    cn.add_argument("name")
+    cn.add_argument("channel")
+    cd = asub.add_parser("channel-delete")
+    cd.add_argument("name")
+    cd.add_argument("channel")
+    ap.set_defaults(fn=do_app)
+
+    ak = sub.add_parser("accesskey")
+    aksub = ak.add_subparsers(dest="ak_command", required=True)
+    akn = aksub.add_parser("new")
+    akn.add_argument("app")
+    akn.add_argument("--key")
+    akn.add_argument("--event", action="append")
+    akl = aksub.add_parser("list")
+    akl.add_argument("app", nargs="?")
+    akd = aksub.add_parser("delete")
+    akd.add_argument("key")
+    ak.set_defaults(fn=do_accesskey)
+
+    imp = sub.add_parser("import")
+    imp.add_argument("--app", required=True, dest="app")
+    imp.add_argument("--input", required=True)
+    imp.add_argument("--channel")
+    imp.set_defaults(fn=do_import)
+
+    exp = sub.add_parser("export")
+    exp.add_argument("--app", required=True, dest="app")
+    exp.add_argument("--output", required=True)
+    exp.add_argument("--channel")
+    exp.set_defaults(fn=do_export)
+
+    def engine_flags(sp, variant_default="default"):
+        sp.add_argument("--engine", help="factory name or pkg.module:factory")
+        sp.add_argument("--engine-id", default="default")
+        sp.add_argument("--engine-version", default="default")
+        sp.add_argument("--variant", default=variant_default)
+        sp.add_argument(
+            "--engine-json", default=None, help="engine variant JSON file"
+        )
+
+    tr = sub.add_parser("train")
+    engine_flags(tr)
+    tr.add_argument("--batch", default="")
+    tr.add_argument("--skip-sanity-check", action="store_true")
+    tr.add_argument("--stop-after-read", action="store_true")
+    tr.add_argument("--stop-after-prepare", action="store_true")
+    tr.set_defaults(fn=do_train)
+
+    ev = sub.add_parser("eval")
+    ev.add_argument("evaluation", help="import path pkg.module:evaluation")
+    ev.set_defaults(fn=do_eval)
+
+    dp = sub.add_parser("deploy")
+    engine_flags(dp)
+    dp.add_argument("--engine-instance-id")
+    dp.add_argument("--ip", default="0.0.0.0")
+    dp.add_argument("--port", type=int, default=8000)
+    dp.add_argument("--feedback", action="store_true")
+    dp.add_argument("--accesskey", default="")
+    dp.set_defaults(fn=do_deploy)
+
+    ud = sub.add_parser("undeploy")
+    ud.add_argument("--ip", default="127.0.0.1")
+    ud.add_argument("--port", type=int, default=8000)
+    ud.add_argument("--accesskey", default="")
+    ud.set_defaults(fn=do_undeploy)
+
+    bp = sub.add_parser("batchpredict")
+    engine_flags(bp)
+    bp.add_argument("--engine-instance-id")
+    bp.add_argument("--input", required=True)
+    bp.add_argument("--output", required=True)
+    bp.set_defaults(fn=do_batchpredict)
+
+    es = sub.add_parser("eventserver")
+    es.add_argument("--ip", default="0.0.0.0")
+    es.add_argument("--port", type=int, default=7070)
+    es.add_argument("--stats", action="store_true")
+    es.set_defaults(fn=do_eventserver)
+
+    ads = sub.add_parser("adminserver")
+    ads.add_argument("--ip", default="0.0.0.0")
+    ads.add_argument("--port", type=int, default=7071)
+    ads.set_defaults(fn=do_adminserver)
+
+    db = sub.add_parser("dashboard")
+    db.add_argument("--ip", default="0.0.0.0")
+    db.add_argument("--port", type=int, default=9000)
+    db.set_defaults(fn=do_dashboard)
+
+    rn = sub.add_parser("run")
+    rn.add_argument("script")
+    rn.add_argument("script_args", nargs="*")
+    rn.set_defaults(fn=do_run)
+
+    tp = sub.add_parser("template")
+    tp.add_argument("template_command", choices=["list"], nargs="?", default="list")
+    tp.set_defaults(fn=do_template)
+
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except CommandError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

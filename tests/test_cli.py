@@ -1,0 +1,171 @@
+"""CLI verb tests (Console.scala dispatch parity) + admin/dashboard routes."""
+
+import json
+
+import pytest
+
+from predictionio_tpu.tools import commands as cmd
+from predictionio_tpu.tools.cli import main as cli_main
+
+
+@pytest.fixture(autouse=True)
+def global_storage(storage, monkeypatch):
+    """Point the CLI's get_storage() at the per-test runtime."""
+    import predictionio_tpu.data.storage.config as config_mod
+
+    monkeypatch.setattr(config_mod, "_runtime", storage)
+    # modules that imported get_storage by name resolve through config_mod
+    return storage
+
+
+class TestAppVerbs:
+    def test_app_lifecycle(self, capsys):
+        assert cli_main(["app", "new", "myapp", "--access-key", "KEY1"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["name"] == "myapp"
+        assert out["accessKeys"][0]["key"] == "KEY1"
+
+        assert cli_main(["app", "list"]) == 0
+        assert json.loads(capsys.readouterr().out)[0]["name"] == "myapp"
+
+        assert cli_main(["app", "channel-new", "myapp", "backtest"]) == 0
+        capsys.readouterr()
+        assert cli_main(["app", "show", "myapp"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["channels"][0]["name"] == "backtest"
+
+        assert cli_main(["app", "delete", "myapp"]) == 0
+        capsys.readouterr()
+        assert cli_main(["app", "show", "myapp"]) == 1
+
+    def test_duplicate_app_fails(self, capsys):
+        assert cli_main(["app", "new", "a1"]) == 0
+        assert cli_main(["app", "new", "a1"]) == 1
+
+    def test_bad_channel_name(self, capsys):
+        cli_main(["app", "new", "a2"])
+        assert cli_main(["app", "channel-new", "a2", "bad name!"]) == 1
+
+
+class TestAccessKeyVerbs:
+    def test_accesskey_lifecycle(self, capsys):
+        cli_main(["app", "new", "akapp"])
+        capsys.readouterr()
+        assert (
+            cli_main(
+                ["accesskey", "new", "akapp", "--key", "K2", "--event", "rate"]
+            )
+            == 0
+        )
+        out = json.loads(capsys.readouterr().out)
+        assert out["key"] == "K2" and out["events"] == ["rate"]
+        assert cli_main(["accesskey", "list", "akapp"]) == 0
+        keys = json.loads(capsys.readouterr().out)
+        assert len(keys) == 2  # default + K2
+        assert cli_main(["accesskey", "delete", "K2"]) == 0
+        capsys.readouterr()
+        assert cli_main(["accesskey", "delete", "K2"]) == 1
+
+
+class TestImportExport:
+    def test_roundtrip(self, tmp_path, capsys, global_storage):
+        cli_main(["app", "new", "io"])
+        src = tmp_path / "in.jsonl"
+        events = [
+            {
+                "event": "rate",
+                "entityType": "user",
+                "entityId": f"u{i}",
+                "targetEntityType": "item",
+                "targetEntityId": "i0",
+                "properties": {"rating": 5.0},
+            }
+            for i in range(7)
+        ]
+        src.write_text("\n".join(json.dumps(e) for e in events))
+        assert cli_main(["import", "--app", "io", "--input", str(src)]) == 0
+        dst = tmp_path / "out.jsonl"
+        assert cli_main(["export", "--app", "io", "--output", str(dst)]) == 0
+        exported = [json.loads(l) for l in dst.read_text().splitlines()]
+        assert len(exported) == 7
+        assert {e["entityId"] for e in exported} == {f"u{i}" for i in range(7)}
+
+
+class TestStatusAndTemplates:
+    def test_status(self, capsys):
+        assert cli_main(["status"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert set(out["storage"]) == {"METADATA", "EVENTDATA", "MODELDATA"}
+        assert all(out["storage"].values())
+
+    def test_template_list(self, capsys):
+        assert cli_main(["template"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert "recommendation" in out["bundled"]
+
+    def test_version(self, capsys):
+        assert cli_main(["version"]) == 0
+        assert capsys.readouterr().out.strip()
+
+
+class TestAdminAPI:
+    def test_admin_routes(self, global_storage):
+        from predictionio_tpu.server.admin import create_admin_app
+        from predictionio_tpu.server.httpd import Request
+
+        app = create_admin_app(global_storage)
+
+        def req(method, path, body=None):
+            return app.handle(
+                Request(
+                    method,
+                    path,
+                    {},
+                    {},
+                    json.dumps(body).encode() if body else b"",
+                )
+            )
+
+        assert req("GET", "/").status == 200
+        r = req("POST", "/cmd/app", {"name": "adminapp"})
+        assert r.status == 201
+        assert json.loads(r.encoded()[0])["name"] == "adminapp"
+        # duplicate -> 409
+        assert req("POST", "/cmd/app", {"name": "adminapp"}).status == 409
+        assert req("GET", "/cmd/app").status == 200
+        assert req("GET", "/cmd/app/adminapp").status == 200
+        assert req("DELETE", "/cmd/app/adminapp/data").status == 200
+        assert req("DELETE", "/cmd/app/adminapp").status == 200
+        assert req("GET", "/cmd/app/adminapp").status == 404
+
+
+class TestDashboard:
+    def test_dashboard_lists_evaluations(self, global_storage):
+        from datetime import datetime, timezone
+
+        from predictionio_tpu.data.storage.base import EvaluationInstance
+        from predictionio_tpu.server.dashboard import create_dashboard_app
+        from predictionio_tpu.server.httpd import Request
+
+        now = datetime.now(tz=timezone.utc)
+        global_storage.evaluation_instances().insert(
+            EvaluationInstance(
+                id="eval1",
+                status="EVALCOMPLETED",
+                start_time=now,
+                end_time=now,
+                evaluation_class="my.Eval",
+                evaluator_results="best: 0.5",
+                evaluator_results_html="<table><tr><td>0.5</td></tr></table>",
+                evaluator_results_json='{"best": 0.5}',
+            )
+        )
+        app = create_dashboard_app(global_storage)
+        page = app.handle(Request("GET", "/", {}, {})).body
+        assert "eval1" in page and "my.Eval" in page
+        detail = app.handle(Request("GET", "/engine_instances/eval1", {}, {})).body
+        assert "0.5" in detail
+        rj = app.handle(
+            Request("GET", "/engine_instances/eval1/evaluator_results.json", {}, {})
+        )
+        assert json.loads(rj.encoded()[0])["best"] == 0.5

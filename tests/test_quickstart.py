@@ -1,0 +1,238 @@
+"""The quickstart user journey, end to end.
+
+Mirrors the reference's integration scenario
+(tests/pio_tests/scenarios/quickstart_test.py:50): create an app, import
+MovieLens-style rate/buy events, train the recommendation engine, deploy,
+POST queries, and check predictions — all against real storage + the real
+HTTP servers on a loopback port.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.tools import commands as cmd
+
+
+def _movielens_events(rng, n_users=30, n_items=20, n_events=400):
+    events = []
+    for _ in range(n_events):
+        u, i = rng.integers(n_users), rng.integers(n_items)
+        if rng.random() < 0.2:
+            events.append(
+                {
+                    "event": "buy",
+                    "entityType": "user",
+                    "entityId": f"u{u}",
+                    "targetEntityType": "item",
+                    "targetEntityId": f"i{i}",
+                }
+            )
+        else:
+            events.append(
+                {
+                    "event": "rate",
+                    "entityType": "user",
+                    "entityId": f"u{u}",
+                    "targetEntityType": "item",
+                    "targetEntityId": f"i{i}",
+                    "properties": {"rating": float(rng.integers(1, 6))},
+                }
+            )
+    return events
+
+
+@pytest.fixture()
+def quickstart_app(storage, tmp_path):
+    d = cmd.app_new(storage, "quickstart")
+    events_file = tmp_path / "events.jsonl"
+    rng = np.random.default_rng(3)
+    with open(events_file, "w") as f:
+        for e in _movielens_events(rng):
+            f.write(json.dumps(e) + "\n")
+    n = cmd.import_events(storage, "quickstart", events_file)
+    assert n == 400
+    return storage, d
+
+
+def test_quickstart_train_deploy_query(quickstart_app):
+    storage, d = quickstart_app
+    from predictionio_tpu.core.base import EngineContext
+    from predictionio_tpu.core.engine import resolve_engine_factory
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.models import recommendation  # noqa: F401
+    from predictionio_tpu.server.prediction_server import create_prediction_server
+
+    engine = resolve_engine_factory("recommendation")()
+    variant = {
+        "datasource": {"params": {"appName": "quickstart"}},
+        "algorithms": [
+            {
+                "name": "als",
+                "params": {"rank": 8, "numIterations": 3, "lambda": 0.01, "seed": 3},
+            }
+        ],
+    }
+    params = engine.params_from_json(variant)
+    instance = run_train(
+        engine,
+        params,
+        ctx=EngineContext(storage=storage),
+        engine_factory="recommendation",
+        storage=storage,
+    )
+    assert instance is not None and instance.status == "COMPLETED"
+
+    server = create_prediction_server(
+        "recommendation", host="127.0.0.1", port=0, storage=storage
+    ).start_background()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        # status page renders
+        page = urllib.request.urlopen(base + "/", timeout=10).read().decode()
+        assert "Engine is deployed" in page
+        # query
+        req = urllib.request.Request(
+            base + "/queries.json",
+            data=json.dumps({"user": "u1", "num": 4}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        got = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        assert len(got["itemScores"]) == 4
+        scores = [s["score"] for s in got["itemScores"]]
+        assert scores == sorted(scores, reverse=True)
+        assert all(s["item"].startswith("i") for s in got["itemScores"])
+        # unknown user still answers (empty or popularity fallback per template)
+        req = urllib.request.Request(
+            base + "/queries.json",
+            data=json.dumps({"user": "nobody", "num": 4}).encode(),
+        )
+        got = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        assert "itemScores" in got
+    finally:
+        server.shutdown()
+
+
+def test_batch_predict(quickstart_app, tmp_path):
+    storage, _ = quickstart_app
+    from predictionio_tpu.core.base import EngineContext
+    from predictionio_tpu.core.batch_predict import run_batch_predict
+    from predictionio_tpu.core.engine import resolve_engine_factory
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.models import recommendation  # noqa: F401
+
+    engine = resolve_engine_factory("recommendation")()
+    params = engine.params_from_json(
+        {
+            "datasource": {"params": {"appName": "quickstart"}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 8, "numIterations": 2}}
+            ],
+        }
+    )
+    run_train(
+        engine, params, ctx=EngineContext(storage=storage), storage=storage,
+        engine_factory="recommendation",
+    )
+    qfile = tmp_path / "queries.jsonl"
+    qfile.write_text(
+        "\n".join(json.dumps({"user": f"u{i}", "num": 3}) for i in range(5))
+    )
+    out = tmp_path / "preds.jsonl"
+    n = run_batch_predict("recommendation", qfile, out, storage=storage)
+    assert n == 5
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert all("prediction" in l and "query" in l for l in lines)
+    assert len(lines[0]["prediction"]["itemScores"]) == 3
+
+
+def test_reload_hot_swap(quickstart_app):
+    """Deploy, retrain, POST /reload — serving swaps to the new instance."""
+    storage, _ = quickstart_app
+    from predictionio_tpu.core.base import EngineContext
+    from predictionio_tpu.core.engine import resolve_engine_factory
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.server.prediction_server import create_prediction_server
+
+    engine = resolve_engine_factory("recommendation")()
+    params = engine.params_from_json(
+        {
+            "datasource": {"params": {"appName": "quickstart"}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 4, "numIterations": 1}}
+            ],
+        }
+    )
+    ctx = EngineContext(storage=storage)
+    first = run_train(engine, params, ctx=ctx, storage=storage,
+                      engine_factory="recommendation")
+    server = create_prediction_server(
+        "recommendation", host="127.0.0.1", port=0, storage=storage
+    ).start_background()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        second = run_train(engine, params, ctx=ctx, storage=storage,
+                           engine_factory="recommendation")
+        assert second.id != first.id
+        req = urllib.request.Request(base + "/reload", method="POST")
+        got = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert got["engineInstanceId"] == second.id
+        st = json.loads(
+            urllib.request.urlopen(base + "/status.json", timeout=10).read()
+        )
+        assert st["engineInstanceId"] == second.id
+    finally:
+        server.shutdown()
+
+
+def test_feedback_loop(quickstart_app):
+    """With feedback on, each query writes a pio_pr predict event
+    (CreateServer.scala:527-589)."""
+    storage, d = quickstart_app
+    from predictionio_tpu.core.base import EngineContext
+    from predictionio_tpu.core.engine import resolve_engine_factory
+    from predictionio_tpu.core.workflow import run_train
+    from predictionio_tpu.data.storage.base import EventFilter
+    from predictionio_tpu.server.prediction_server import (
+        FeedbackConfig,
+        create_prediction_server,
+    )
+
+    engine = resolve_engine_factory("recommendation")()
+    params = engine.params_from_json(
+        {
+            "datasource": {"params": {"appName": "quickstart"}},
+            "algorithms": [
+                {"name": "als", "params": {"rank": 4, "numIterations": 1}}
+            ],
+        }
+    )
+    run_train(engine, params, ctx=EngineContext(storage=storage), storage=storage,
+              engine_factory="recommendation")
+    access_key = d.keys[0].key
+    server = create_prediction_server(
+        "recommendation",
+        host="127.0.0.1",
+        port=0,
+        storage=storage,
+        feedback=FeedbackConfig(enabled=True, access_key=access_key),
+    ).start_background()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/queries.json",
+            data=json.dumps({"user": "u1", "num": 2}).encode(),
+        )
+        urllib.request.urlopen(req, timeout=30)
+        fb = list(
+            storage.l_events().find(
+                d.app.id, None, EventFilter(event_names=("predict",))
+            )
+        )
+        assert len(fb) == 1
+        assert fb[0].entity_type == "pio_pr"
+        assert fb[0].properties.get("prediction")["itemScores"]
+    finally:
+        server.shutdown()
