@@ -1,0 +1,21 @@
+"""Reusable algorithm library (the reference's `e2/` module)."""
+
+from predictionio_tpu.e2.engine import (
+    BinaryVectorizer,
+    CategoricalNaiveBayes,
+    CategoricalNaiveBayesModel,
+    LabeledPoint,
+    MarkovChain,
+    MarkovChainModel,
+)
+from predictionio_tpu.e2.evaluation import split_data
+
+__all__ = [
+    "BinaryVectorizer",
+    "CategoricalNaiveBayes",
+    "CategoricalNaiveBayesModel",
+    "LabeledPoint",
+    "MarkovChain",
+    "MarkovChainModel",
+    "split_data",
+]

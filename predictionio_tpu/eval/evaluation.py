@@ -32,15 +32,26 @@ class Evaluation:
         return list(eps()) if callable(eps) else list(eps)
 
 
-def resolve_evaluation(path: str) -> Evaluation:
-    """Import an Evaluation by ``pkg.module:attr`` path."""
+def resolve_evaluation(path: str, kwargs: dict | None = None) -> Evaluation:
+    """Import an Evaluation by ``pkg.module:attr`` path.
+
+    ``kwargs`` are passed when the attr is a factory callable (the way the
+    reference's Evaluation objects bake in appName, user factories here take
+    it as a parameter: ``pio eval pkg.mod:evaluation --params '{"app_name":
+    "myapp"}'``).
+    """
     from predictionio_tpu.utils.registry import resolve_import_path
 
     obj = resolve_import_path(path)
     if obj is None:
         raise KeyError(f"evaluation {path!r} not found")
     if callable(obj) and not isinstance(obj, Evaluation):
-        obj = obj()
+        obj = obj(**(kwargs or {}))
+    elif kwargs:
+        raise TypeError(
+            f"{path!r} is an Evaluation instance; --params only applies to "
+            "factory callables"
+        )
     if not isinstance(obj, Evaluation):
         raise TypeError(f"{path!r} did not resolve to an Evaluation")
     return obj

@@ -11,4 +11,9 @@ Importing this package registers every bundled engine factory (the reflective
 EngineFactory discovery analog, workflow/WorkflowUtils.scala:47).
 """
 
-from predictionio_tpu.models import recommendation  # noqa: F401
+from predictionio_tpu.models import (  # noqa: F401
+    classification,
+    ecommerce,
+    recommendation,
+    similarproduct,
+)

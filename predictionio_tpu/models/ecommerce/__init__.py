@@ -1,0 +1,29 @@
+from predictionio_tpu.models.ecommerce.engine import (
+    DataSourceParams,
+    ECommAlgorithm,
+    ECommAlgorithmParams,
+    ECommDataSource,
+    ECommModel,
+    ECommPreparator,
+    ECommServing,
+    Item,
+    ItemScore,
+    PredictedResult,
+    Query,
+    ecommerce_engine,
+)
+
+__all__ = [
+    "DataSourceParams",
+    "ECommAlgorithm",
+    "ECommAlgorithmParams",
+    "ECommDataSource",
+    "ECommModel",
+    "ECommPreparator",
+    "ECommServing",
+    "Item",
+    "ItemScore",
+    "PredictedResult",
+    "Query",
+    "ecommerce_engine",
+]

@@ -1,0 +1,73 @@
+"""Vectorized serving-time candidate filtering shared by the rec templates.
+
+The isCandidateItem checks of the similarproduct/ecommerce references
+(ALSAlgorithm.scala isCandidateItem, ECommAlgorithm.isCandidateItem) as one
+numpy mask build: whiteList/blackList/query-item exclusion via ``np.isin``
+over the vocab's key array, and category membership via a per-model
+category->bool-array index built once and cached (predict runs per query —
+no per-item Python loops in the hot path).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Mapping
+
+import numpy as np
+
+from predictionio_tpu.data.bimap import BiMap
+
+
+class CategoryIndex:
+    """category name -> boolean membership array over item indices."""
+
+    def __init__(self, item_vocab: BiMap, items_categories: Mapping[str, Iterable[str]]):
+        n = len(item_vocab)
+        self._by_cat: dict[str, np.ndarray] = {}
+        for item_id, cats in items_categories.items():
+            idx = item_vocab.get(item_id)
+            if idx is None:
+                continue
+            for c in cats:
+                arr = self._by_cat.get(c)
+                if arr is None:
+                    arr = self._by_cat[c] = np.zeros(n, bool)
+                arr[idx] = True
+        self._n = n
+
+    def any_of(self, categories: Iterable[str]) -> np.ndarray:
+        """Items belonging to at least one of the categories."""
+        mask = np.zeros(self._n, bool)
+        for c in categories:
+            arr = self._by_cat.get(c)
+            if arr is not None:
+                mask |= arr
+        return mask
+
+
+def exclude_mask(
+    item_vocab: BiMap,
+    category_index: CategoryIndex | None = None,
+    query_idx: Iterable[int] = (),
+    white_list: Iterable[str] | None = None,
+    black_list: Iterable[str] = (),
+    categories: Iterable[str] | None = None,
+    category_black_list: Iterable[str] | None = None,
+) -> np.ndarray:
+    """True = item filtered out of the candidate set."""
+    n = len(item_vocab)
+    exclude = np.zeros(n, bool)
+    qi = list(query_idx)
+    if qi:
+        exclude[qi] = True
+    keys = item_vocab.keys_array()
+    if white_list is not None:
+        exclude |= ~np.isin(keys, np.asarray(list(white_list), object))
+    bl = list(black_list)
+    if bl:
+        exclude |= np.isin(keys, np.asarray(bl, object))
+    if category_index is not None:
+        if categories:
+            exclude |= ~category_index.any_of(categories)
+        if category_black_list:
+            exclude |= category_index.any_of(category_black_list)
+    return exclude

@@ -129,9 +129,13 @@ def _half_step(
     """One alternating update: recompute factors for ``seg`` entities."""
     v = other_factors[other_idx]
     if p.implicit_prefs:
-        conf_minus_1 = p.alpha * rating * valid
+        # MLlib trainImplicit semantics: confidence from |r|, preference
+        # p = 1 iff r > 0 — negative ratings are high-confidence negatives
+        # (the similarproduct LikeAlgorithm dislike path).
+        conf_minus_1 = p.alpha * jnp.abs(rating) * valid
         a_weight = conf_minus_1  # Vu^T diag(c-1) Vu part
-        rhs = (1.0 + conf_minus_1) * valid  # c * p with p=1
+        pref = (rating > 0).astype(v.dtype)
+        rhs = (1.0 + conf_minus_1) * pref * valid  # c * p
         # other_factors is replicated, so the Gram needs no collective.
         gram = other_factors.T @ other_factors
     else:

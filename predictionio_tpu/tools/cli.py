@@ -187,7 +187,9 @@ def do_eval(args) -> int:
     from predictionio_tpu.eval.evaluator import MetricEvaluator
 
     _load_engine_modules()
-    evaluation = resolve_evaluation(args.evaluation)
+    evaluation = resolve_evaluation(
+        args.evaluation, json.loads(args.params) if args.params else None
+    )
     engine = evaluation.engine_factory()
     result = run_evaluation(
         engine,
@@ -197,8 +199,7 @@ def do_eval(args) -> int:
         evaluation_class=args.evaluation,
     )
     print(result.one_liner())
-    best = result.best()
-    print(f"Best score: {best.score}")
+    print(f"Best score: {result.best.score}")
     return 0
 
 
@@ -421,6 +422,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     ev = sub.add_parser("eval")
     ev.add_argument("evaluation", help="import path pkg.module:evaluation")
+    ev.add_argument(
+        "--params", default=None, help="JSON kwargs for a callable evaluation"
+    )
     ev.set_defaults(fn=do_eval)
 
     dp = sub.add_parser("deploy")

@@ -1,0 +1,125 @@
+"""e2 algorithm library tests (mirrors e2/src/test fixtures)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.e2 import (
+    BinaryVectorizer,
+    CategoricalNaiveBayes,
+    LabeledPoint,
+    MarkovChain,
+    split_data,
+)
+
+
+class TestCategoricalNaiveBayes:
+    """Fixture mirrors e2 NaiveBayesFixture: weather-ish string features."""
+
+    POINTS = [
+        LabeledPoint("play", ("sunny", "mild", "normal")),
+        LabeledPoint("play", ("overcast", "hot", "high")),
+        LabeledPoint("play", ("rain", "mild", "high")),
+        LabeledPoint("stay", ("rain", "cool", "high")),
+        LabeledPoint("stay", ("sunny", "hot", "high")),
+        LabeledPoint("stay", ("sunny", "hot", "normal")),
+    ]
+
+    def test_priors_and_likelihoods(self):
+        model = CategoricalNaiveBayes.train(self.POINTS)
+        assert model.priors["play"] == pytest.approx(math.log(0.5))
+        assert model.priors["stay"] == pytest.approx(math.log(0.5))
+        # P(sunny | play) = 1/3
+        assert model.likelihoods["play"][0]["sunny"] == pytest.approx(
+            math.log(1 / 3)
+        )
+        # P(high | stay) = 2/3
+        assert model.likelihoods["stay"][2]["high"] == pytest.approx(
+            math.log(2 / 3)
+        )
+
+    def test_log_score_and_predict(self):
+        model = CategoricalNaiveBayes.train(self.POINTS)
+        s = model.log_score(LabeledPoint("play", ("rain", "mild", "high")))
+        assert s == pytest.approx(
+            math.log(0.5) + math.log(1 / 3) + math.log(2 / 3) + math.log(2 / 3)
+        )
+        # unseen value -> -inf by default
+        assert model.log_score(
+            LabeledPoint("play", ("snow", "mild", "high"))
+        ) == float("-inf")
+        # unknown label -> None
+        assert model.log_score(LabeledPoint("nope", ("rain", "mild", "high"))) is None
+        assert model.predict(("rain", "mild", "high")) == "play"
+        assert model.predict(("sunny", "hot", "high")) == "stay"
+
+    def test_default_likelihood_override(self):
+        model = CategoricalNaiveBayes.train(self.POINTS)
+        s = model.log_score(
+            LabeledPoint("play", ("snow", "mild", "high")),
+            default_likelihood=lambda vals: min(vals) - 1.0,
+        )
+        assert np.isfinite(s)
+
+
+class TestMarkovChain:
+    def test_train_and_predict(self):
+        # 3 states; from 0: ->1 (3 times), ->2 (1 time)
+        rows = [0, 0, 1, 2]
+        cols = [1, 2, 2, 0]
+        counts = [3.0, 1.0, 2.0, 5.0]
+        model = MarkovChain.train(rows, cols, counts, n_states=3, top_n=2)
+        probs = model.predict([1.0, 0.0, 0.0])
+        assert probs[1] == pytest.approx(0.75)
+        assert probs[2] == pytest.approx(0.25)
+        # distribute from state 2 -> state 0 with prob 1
+        probs = model.predict([0.0, 0.0, 1.0])
+        assert probs[0] == pytest.approx(1.0)
+
+    def test_top_n_truncation(self):
+        rows = [0, 0, 0]
+        cols = [1, 2, 3]
+        counts = [5.0, 3.0, 1.0]
+        model = MarkovChain.train(rows, cols, counts, n_states=4, top_n=2)
+        probs = model.predict([1.0, 0.0, 0.0, 0.0])
+        assert probs[3] == 0.0  # truncated away
+        assert probs[1] == pytest.approx(5 / 9)
+
+
+class TestBinaryVectorizer:
+    def test_fit_and_transform(self):
+        maps = [
+            {"color": "red", "size": "big", "junk": "x"},
+            {"color": "blue", "size": "big"},
+        ]
+        vec = BinaryVectorizer.fit(maps, properties={"color", "size"})
+        assert vec.num_features == 3  # (color,red), (size,big), (color,blue)
+        out = vec.transform([{"color": "red", "size": "big"}])
+        assert out.shape == (1, 3)
+        assert out.sum() == 2.0
+        # unknown pair ignored
+        assert vec.to_binary([("color", "green")]).sum() == 0.0
+
+    def test_from_pairs_ordering(self):
+        vec = BinaryVectorizer.from_pairs([("a", "1"), ("b", "2")])
+        assert list(vec.to_binary([("b", "2")])) == [0.0, 1.0]
+
+
+class TestSplitData:
+    def test_kfold_partitions(self):
+        data = list(range(10))
+        folds = split_data(
+            3,
+            data,
+            {"k": 3},
+            training_data_creator=list,
+            query_creator=lambda d: ("q", d),
+            actual_creator=lambda d: ("a", d),
+        )
+        assert len(folds) == 3
+        for fold_idx, (train, info, qa) in enumerate(folds):
+            assert info == {"k": 3}
+            test_points = {d for _, (q, d) in [(None, q) for q, _ in qa]}
+            assert all(i % 3 == fold_idx for i in test_points)
+            assert sorted(train + list(test_points)) == data
