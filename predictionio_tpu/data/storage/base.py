@@ -288,7 +288,12 @@ class LEvents(abc.ABC):
 
     @abc.abstractmethod
     def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
-        """Insert one event, returning its id."""
+        """Insert one event, returning its id.
+
+        An event carrying an existing ``event_id`` upserts that row
+        (implementations must replace, not duplicate) — the self-cleaning
+        compaction path relies on this.
+        """
 
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: int | None = None

@@ -1,0 +1,8 @@
+from predictionio_tpu.models.ncf.engine import (
+    NCFAlgorithm,
+    NCFAlgorithmParams,
+    NCFModel,
+    ncf_engine,
+)
+
+__all__ = ["NCFAlgorithm", "NCFAlgorithmParams", "NCFModel", "ncf_engine"]

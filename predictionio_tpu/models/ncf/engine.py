@@ -1,0 +1,172 @@
+"""Deep recommendation template: NCF / two-tower with sharded embeddings.
+
+The pypio deep-rec configuration (BASELINE.json configs[4]).  Reuses the
+recommendation template's event schema (rate/buy user->item events,
+DataSource parity with examples/scala-parallel-recommendation) but trains
+the NCF two-tower model of ops/ncf.py: embedding tables row-sharded over the
+mesh ``model`` axis, batches over ``data``, BPR loss, one compiled step.
+
+Query/result shapes match the recommendation template ({user, num} ->
+{itemScores}) so the serving stack and evaluation metrics apply unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.core.base import Algorithm, EngineContext, SanityCheckError
+from predictionio_tpu.core.engine import Engine, engine_factory
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.models.recommendation.engine import (
+    ItemScore,
+    PredictedResult,
+    PreparedData,
+    Query,
+    RatingsDataSource,
+    RatingsPreparator,
+    RecommendationServing,
+)
+from predictionio_tpu.ops.ncf import (
+    NCFParams,
+    NCFState,
+    score_all_items,
+    train_ncf,
+)
+
+
+@dataclass(frozen=True)
+class NCFAlgorithmParams:
+    embed_dim: int = 32
+    mlp_layers: tuple[int, ...] = (64, 32, 16)
+    learning_rate: float = 1e-3
+    num_epochs: int = 5
+    batch_size: int = 8192
+    positive_threshold: float = 4.0  # ratings >= this are positives
+    seed: int = 3
+
+    params_aliases = {
+        "embedDim": "embed_dim",
+        "mlpLayers": "mlp_layers",
+        "learningRate": "learning_rate",
+        "numEpochs": "num_epochs",
+        "batchSize": "batch_size",
+        "positiveThreshold": "positive_threshold",
+    }
+
+
+@partial(jax.jit, static_argnames=("n_items", "k"))
+def _score_topk(params, user_idx, n_items: int, k: int):
+    """Serving hot path as ONE compiled program: score every item, mask
+    table padding rows, top-k (the recommendation template's
+    _topk_for_user pattern)."""
+    scores = score_all_items(params, user_idx)
+    masked = jnp.where(jnp.arange(scores.shape[0]) < n_items, scores, -jnp.inf)
+    return jax.lax.top_k(masked, k)
+
+
+@dataclass
+class NCFModel:
+    state: NCFState
+    user_vocab: BiMap
+    item_vocab: BiMap
+
+    def sanity_check(self):
+        leaf = np.asarray(self.state.params["user_gmf"])
+        if not np.isfinite(leaf).all():
+            raise SanityCheckError("NCF embeddings are not finite")
+
+
+class NCFAlgorithm(Algorithm):
+    """flavor P: the model trains AND can serve mesh-sharded; persistence
+    gathers the pytree to host numpy (make_persistent_model)."""
+
+    flavor = "P"
+    params_class = NCFAlgorithmParams
+    query_class = Query
+
+    def __init__(self, params: NCFAlgorithmParams | None = None):
+        self.params = params or NCFAlgorithmParams()
+
+    def train(self, ctx: EngineContext, pd: PreparedData) -> NCFModel:
+        p = self.params
+        positives = pd.ratings >= p.positive_threshold
+        if not positives.any():
+            raise SanityCheckError(
+                f"no positive interactions (rating >= {p.positive_threshold})"
+            )
+        mesh = ctx.mesh if ctx.mesh.devices.size > 1 else None
+        state = train_ncf(
+            pd.user_idx[positives],
+            pd.item_idx[positives],
+            n_users=len(pd.user_vocab),
+            n_items=len(pd.item_vocab),
+            params=NCFParams(
+                embed_dim=p.embed_dim,
+                mlp_layers=tuple(p.mlp_layers),
+                learning_rate=p.learning_rate,
+                num_epochs=p.num_epochs,
+                batch_size=p.batch_size,
+                seed=p.seed,
+            ),
+            mesh=mesh,
+        )
+        return NCFModel(
+            state=state, user_vocab=pd.user_vocab, item_vocab=pd.item_vocab
+        )
+
+    def predict(self, model: NCFModel, query: Query) -> PredictedResult:
+        uidx = model.user_vocab.get(query.user)
+        if uidx is None:
+            return PredictedResult()
+        n_items = len(model.item_vocab)
+        k = min(query.num, n_items)
+        top_s, top_i = _score_topk(
+            model.state.params, jnp.int32(uidx), n_items, k
+        )
+        return PredictedResult(
+            item_scores=tuple(
+                ItemScore(item=model.item_vocab.inverse(int(i)), score=float(s))
+                for s, i in zip(np.asarray(top_s), np.asarray(top_i))
+                if np.isfinite(s)
+            )
+        )
+
+    def make_persistent_model(self, ctx: EngineContext, model: NCFModel):
+        return {
+            "params": jax.tree_util.tree_map(
+                lambda x: np.asarray(jax.device_get(x)), model.state.params
+            ),
+            "n_users": model.state.n_users,
+            "n_items": model.state.n_items,
+            "config": model.state.config,
+            "user_vocab": model.user_vocab.to_state(),
+            "item_vocab": model.item_vocab.to_state(),
+        }
+
+    def load_persistent_model(self, ctx: EngineContext, data) -> NCFModel:
+        return NCFModel(
+            state=NCFState(
+                params=jax.tree_util.tree_map(jnp.asarray, data["params"]),
+                n_users=data["n_users"],
+                n_items=data["n_items"],
+                config=data["config"],
+            ),
+            user_vocab=BiMap.from_state(data["user_vocab"]),
+            item_vocab=BiMap.from_state(data["item_vocab"]),
+        )
+
+
+@engine_factory("ncf")
+def ncf_engine() -> Engine:
+    return Engine(
+        RatingsDataSource,
+        RatingsPreparator,
+        {"ncf": NCFAlgorithm},
+        RecommendationServing,
+    )

@@ -1,0 +1,218 @@
+"""Neural Collaborative Filtering (two-tower GMF + MLP) with sharded tables.
+
+The deep-rec configuration (BASELINE.json configs[4]: "NCF / two-tower in
+JAX, sharded user x item embedding tables") — the one genuinely
+model-parallel component of the framework (SURVEY.md §2.9):
+
+  - embedding tables are ROW-SHARDED over the mesh ``model`` axis
+    (NamedSharding P("model", None)); XLA GSPMD turns the per-batch gathers
+    into collective lookups over ICI;
+  - the interaction batch is sharded over ``data`` (pure data parallelism);
+  - MLP weights are replicated; their gradients all-reduce automatically;
+  - the whole optimization step (forward, BPR loss, backward, Adam update)
+    is ONE jit program — no per-step host round trips.
+
+Architecture follows the NCF paper shape: a GMF branch (elementwise product
+of user/item vectors) and an MLP branch (concat -> relu stack), fused by a
+final linear layer.  Training uses BPR ranking loss over sampled negatives.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PSpec
+
+
+@dataclass(frozen=True)
+class NCFParams:
+    embed_dim: int = 32
+    mlp_layers: tuple[int, ...] = (64, 32, 16)
+    learning_rate: float = 1e-3
+    num_epochs: int = 5
+    batch_size: int = 8192
+    negatives_per_positive: int = 4
+    seed: int = 3
+
+
+def init_ncf(rng: jax.Array, n_users: int, n_items: int, p: NCFParams) -> dict:
+    """Parameter pytree.  Table rows are padded by the caller so the
+    ``model`` axis divides them evenly."""
+    keys = jax.random.split(rng, 6 + 2 * len(p.mlp_layers))
+    d = p.embed_dim
+    scale = 1.0 / math.sqrt(d)
+    params = {
+        # separate GMF and MLP tables, as in the NCF paper
+        "user_gmf": jax.random.normal(keys[0], (n_users, d)) * scale,
+        "item_gmf": jax.random.normal(keys[1], (n_items, d)) * scale,
+        "user_mlp": jax.random.normal(keys[2], (n_users, d)) * scale,
+        "item_mlp": jax.random.normal(keys[3], (n_items, d)) * scale,
+        "mlp": [],
+        "out_w": jax.random.normal(keys[4], (d + p.mlp_layers[-1], 1)) * 0.1,
+        "out_b": jnp.zeros((1,)),
+    }
+    in_dim = 2 * d
+    for li, width in enumerate(p.mlp_layers):
+        params["mlp"].append(
+            {
+                "w": jax.random.normal(keys[5 + 2 * li], (in_dim, width))
+                * math.sqrt(2.0 / in_dim),
+                "b": jnp.zeros((width,)),
+            }
+        )
+        in_dim = width
+    return params
+
+
+def ncf_forward(params: dict, user_idx: jax.Array, item_idx: jax.Array) -> jax.Array:
+    """Interaction scores for (user, item) pairs: [batch]."""
+    ug = params["user_gmf"][user_idx]
+    ig = params["item_gmf"][item_idx]
+    um = params["user_mlp"][user_idx]
+    im = params["item_mlp"][item_idx]
+    gmf = ug * ig  # [b, d]
+    h = jnp.concatenate([um, im], axis=-1)
+    for layer in params["mlp"]:
+        h = jax.nn.relu(h @ layer["w"] + layer["b"])
+    fused = jnp.concatenate([gmf, h], axis=-1)
+    return (fused @ params["out_w"] + params["out_b"])[..., 0]
+
+
+def score_all_items(params: dict, user_idx: jax.Array) -> jax.Array:
+    """One user against every item: [n_items] (the serving top-k path).
+
+    The MLP tower broadcasts the user row against the full item table —
+    a handful of [n_items, d] matmuls on the MXU.
+    """
+    n_items = params["item_gmf"].shape[0]
+    ug = params["user_gmf"][user_idx]  # [d]
+    um = params["user_mlp"][user_idx]
+    gmf = ug[None, :] * params["item_gmf"]  # [n_items, d]
+    h = jnp.concatenate(
+        [jnp.broadcast_to(um, (n_items, um.shape[0])), params["item_mlp"]], axis=-1
+    )
+    for layer in params["mlp"]:
+        h = jax.nn.relu(h @ layer["w"] + layer["b"])
+    fused = jnp.concatenate([gmf, h], axis=-1)
+    return (fused @ params["out_w"] + params["out_b"])[..., 0]
+
+
+def bpr_loss(params: dict, user_idx, pos_idx, neg_idx, valid) -> jax.Array:
+    """Bayesian Personalized Ranking: -log sigmoid(s_pos - s_neg)."""
+    pos = ncf_forward(params, user_idx, pos_idx)
+    neg = ncf_forward(params, user_idx, neg_idx)
+    losses = -jax.nn.log_sigmoid(pos - neg) * valid
+    return losses.sum() / jnp.maximum(valid.sum(), 1.0)
+
+
+def param_shardings(mesh: Mesh, params: dict) -> dict:
+    """Tables row-sharded over ``model``; everything else replicated.
+
+    A mesh without a ``model`` axis (pure data parallelism, the engine
+    default) replicates the tables too.
+    """
+    has_model = "model" in mesh.shape
+
+    def one(path_leaf):
+        path, _ = path_leaf
+        name = path[0].key if hasattr(path[0], "key") else str(path[0])
+        if has_model and name in ("user_gmf", "item_gmf", "user_mlp", "item_mlp"):
+            return NamedSharding(mesh, PSpec("model", None))
+        return NamedSharding(mesh, PSpec())
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    return jax.tree_util.tree_unflatten(treedef, [one(f) for f in flat])
+
+
+@dataclass
+class NCFState:
+    params: dict  # pytree (device arrays, possibly sharded)
+    n_users: int
+    n_items: int
+    config: NCFParams
+
+
+def make_train_step(optimizer):
+    """The single compiled train step: grad + all-reduce (by GSPMD) + Adam."""
+
+    @jax.jit
+    def step(params, opt_state, user_idx, pos_idx, neg_idx, valid):
+        loss, grads = jax.value_and_grad(bpr_loss)(
+            params, user_idx, pos_idx, neg_idx, valid
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step
+
+
+def train_ncf(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    n_users: int,
+    n_items: int,
+    params: NCFParams | None = None,
+    mesh: Mesh | None = None,
+) -> NCFState:
+    """Train from positive (user, item) interactions with sampled negatives.
+
+    With a mesh, tables are placed row-sharded over ``model`` and batches
+    sharded over ``data``; single-device runs skip placement entirely.
+    """
+    p = params or NCFParams()
+    rng = np.random.default_rng(p.seed)
+
+    # pad table rows for even model-axis sharding
+    model_par = mesh.shape.get("model", 1) if mesh is not None else 1
+    n_users_pad = ((n_users + model_par - 1) // model_par) * model_par
+    n_items_pad = ((n_items + model_par - 1) // model_par) * model_par
+
+    net = init_ncf(jax.random.PRNGKey(p.seed), n_users_pad, n_items_pad, p)
+    optimizer = optax.adam(p.learning_rate)
+
+    data_sharding = None
+    if mesh is not None:
+        shardings = param_shardings(mesh, net)
+        net = jax.device_put(net, shardings)
+        if "data" in mesh.shape:
+            data_sharding = NamedSharding(mesh, PSpec("data"))
+    opt_state = optimizer.init(net)
+    step = make_train_step(optimizer)
+
+    n_pos = len(user_idx)
+    bs = min(p.batch_size, max(n_pos, 1))
+    data_par = mesh.shape.get("data", 1) if mesh is not None else 1
+    bs = ((bs + data_par - 1) // data_par) * data_par
+
+    last_loss = None
+    for _ in range(p.num_epochs):
+        order = rng.permutation(n_pos)
+        for start in range(0, n_pos, bs):
+            sel = order[start : start + bs]
+            u = user_idx[sel].astype(np.int32)
+            pos = item_idx[sel].astype(np.int32)
+            # one sampled negative per positive per step; extra negatives
+            # come from running more epochs (same expected update count)
+            neg = rng.integers(0, n_items, len(sel), dtype=np.int32)
+            valid = np.ones(len(sel), np.float32)
+            if len(sel) < bs:  # static shapes: pad the tail batch
+                pad = bs - len(sel)
+                u = np.pad(u, (0, pad))
+                pos = np.pad(pos, (0, pad))
+                neg = np.pad(neg, (0, pad))
+                valid = np.pad(valid, (0, pad))
+            if data_sharding is not None:
+                u, pos, neg, valid = (
+                    jax.device_put(x, data_sharding) for x in (u, pos, neg, valid)
+                )
+            net, opt_state, last_loss = step(net, opt_state, u, pos, neg, valid)
+    if last_loss is not None:
+        jax.block_until_ready(last_loss)
+    return NCFState(params=net, n_users=n_users, n_items=n_items, config=p)

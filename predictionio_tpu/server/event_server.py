@@ -90,12 +90,17 @@ def _authenticate(storage: StorageRuntime, req: Request) -> AuthData:
 
 
 def create_event_server_app(
-    storage: StorageRuntime | None = None, stats: bool = False
+    storage: StorageRuntime | None = None,
+    stats: bool = False,
+    plugins: "PluginContext | None" = None,
 ) -> HTTPApp:
+    from predictionio_tpu.server.plugins import PluginContext
+
     storage = storage or get_storage()
     app = HTTPApp("eventserver")
     hourly = HourlyStats() if stats else None
     levents = storage.l_events()
+    plugins = plugins or PluginContext.from_env()
 
     def authed(handler):
         def wrapped(req: Request) -> Response:
@@ -136,6 +141,10 @@ def create_event_server_app(
             return error_response(400, f"invalid JSON: {e}")
         if auth.events and event.event not in auth.events:
             return error_response(403, f"{event.event} events are not allowed")
+        try:
+            plugins.process_input(auth.app_id, auth.channel_id, event)
+        except Exception as e:  # an input blocker rejected the event
+            return error_response(403, f"rejected by plugin: {e}")
         event_id = levents.insert(event, auth.app_id, auth.channel_id)
         bookkeep(auth, 201, event)
         return json_response(201, {"eventId": event_id})
@@ -222,6 +231,13 @@ def create_event_server_app(
                 )
                 continue
             try:
+                plugins.process_input(auth.app_id, auth.channel_id, event)
+            except Exception as e:
+                results.append(
+                    {"status": 403, "message": f"rejected by plugin: {e}"}
+                )
+                continue
+            try:
                 event_id = levents.insert(event, auth.app_id, auth.channel_id)
             except Exception as e:
                 results.append({"status": 500, "message": str(e)})
@@ -246,6 +262,10 @@ def create_event_server_app(
     _form_connectors = form_connectors()
 
     def _webhook_insert(auth: AuthData, event: Event) -> Response:
+        try:
+            plugins.process_input(auth.app_id, auth.channel_id, event)
+        except Exception as e:
+            return error_response(403, f"rejected by plugin: {e}")
         event_id = levents.insert(event, auth.app_id, auth.channel_id)
         bookkeep(auth, 201, event)
         return json_response(201, {"eventId": event_id})
@@ -315,6 +335,9 @@ def create_event_server(
     port: int = 7070,
     storage: StorageRuntime | None = None,
     stats: bool = False,
+    plugins: "PluginContext | None" = None,
 ) -> AppServer:
     """Bind the event server (EventServer.createEventServer:528)."""
-    return AppServer(create_event_server_app(storage, stats=stats), host, port)
+    return AppServer(
+        create_event_server_app(storage, stats=stats, plugins=plugins), host, port
+    )

@@ -173,9 +173,13 @@ def create_prediction_server_app(
     feedback: FeedbackConfig | None = None,
     on_stop: Callable[[], None] | None = None,
     access_key: str | None = None,
+    plugins: "PluginContext | None" = None,
 ) -> HTTPApp:
+    from predictionio_tpu.server.plugins import PluginContext
+
     app = HTTPApp("predictionserver")
     feedback = feedback or FeedbackConfig()
+    plugins = plugins or PluginContext.from_env()
     stats = {"request_count": 0, "avg_serving_sec": 0.0, "last_serving_sec": 0.0}
     stats_lock = threading.Lock()
     started_at = datetime.now(tz=timezone.utc)
@@ -257,6 +261,9 @@ def create_prediction_server_app(
             log.exception("query serving failed")
             return error_response(500, f"{type(e).__name__}: {e}")
         rendered = _render_prediction(prediction)
+        rendered = plugins.process_output(
+            deployed.instance.id, payload, rendered
+        )
         if feedback.enabled and feedback.app_id is not None:
             try:
                 _feedback_event(query, rendered)
@@ -287,6 +294,32 @@ def create_prediction_server_app(
         if on_stop is not None:
             threading.Thread(target=on_stop, daemon=True).start()
         return json_response(200, {"message": "Shutting down."})
+
+    # -- profiling (the jax.profiler analog of Spark's job UI, SURVEY §5.1) --
+    @app.route("POST", "/profiler/start")
+    def profiler_start(req: Request) -> Response:
+        if not _authorized(req):
+            return error_response(401, "Invalid accessKey.")
+        import jax
+
+        trace_dir = req.query.get("dir", "/tmp/pio-profile")
+        try:
+            jax.profiler.start_trace(trace_dir)
+        except Exception as e:
+            return error_response(409, f"profiler not started: {e}")
+        return json_response(200, {"message": "tracing", "dir": trace_dir})
+
+    @app.route("POST", "/profiler/stop")
+    def profiler_stop(req: Request) -> Response:
+        if not _authorized(req):
+            return error_response(401, "Invalid accessKey.")
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            return error_response(409, f"profiler not stopped: {e}")
+        return json_response(200, {"message": "trace written"})
 
     return app
 

@@ -1,0 +1,167 @@
+"""Aux subsystem tests: plugins, self-cleaning data source."""
+
+import dataclasses
+import json
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from predictionio_tpu.core.base import EngineContext
+from predictionio_tpu.core.self_cleaning import (
+    EventWindow,
+    SelfCleaningDataSource,
+)
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.server.plugins import (
+    INPUT_BLOCKER,
+    OUTPUT_BLOCKER,
+    OUTPUT_SNIFFER,
+    EngineServerPlugin,
+    EventServerPlugin,
+    PluginContext,
+)
+from predictionio_tpu.tools import commands as cmd
+
+
+class RejectBuys(EventServerPlugin):
+    plugin_type = INPUT_BLOCKER
+
+    def process(self, app_id, channel_id, event):
+        if event.event == "buy":
+            raise ValueError("buys are blocked")
+
+
+class Uppercase(EngineServerPlugin):
+    plugin_type = OUTPUT_BLOCKER
+
+    def process(self, engine_instance_id, query, prediction):
+        return {**prediction, "blocked": True}
+
+
+class TestPlugins:
+    def test_input_blocker_rejects(self, storage):
+        from predictionio_tpu.server.event_server import create_event_server_app
+        from predictionio_tpu.server.httpd import Request
+
+        d = cmd.app_new(storage, "plug", access_key="PK")
+        ctx = PluginContext()
+        ctx.register(RejectBuys())
+        app = create_event_server_app(storage, plugins=ctx)
+
+        def post(event_name):
+            body = json.dumps(
+                {"event": event_name, "entityType": "user", "entityId": "u1"}
+            ).encode()
+            return app.handle(
+                Request("POST", "/events.json", {"accessKey": "PK"}, {}, body)
+            )
+
+        assert post("view").status == 201
+        assert post("buy").status == 403
+
+    def test_output_blocker_transforms(self):
+        ctx = PluginContext()
+        ctx.register(Uppercase())
+        out = ctx.process_output("inst1", {"q": 1}, {"itemScores": []})
+        assert out["blocked"] is True
+
+    def test_sniffer_errors_are_swallowed(self):
+        class Boom(EngineServerPlugin):
+            plugin_type = OUTPUT_SNIFFER
+
+            def process(self, *a):
+                raise RuntimeError("boom")
+
+        ctx = PluginContext()
+        ctx.register(Boom())
+        out = ctx.process_output("inst1", {}, {"ok": 1})
+        assert out == {"ok": 1}
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("PIO_PLUGINS", "tests.test_aux:RejectBuys")
+        ctx = PluginContext.from_env()
+        assert len(ctx.of_type(INPUT_BLOCKER)) == 1
+
+
+def _ev(event, eid, props=None, days_ago=0.0, event_id=None):
+    t = datetime.now(tz=timezone.utc) - timedelta(days=days_ago)
+    return Event(
+        event=event,
+        entity_type="user",
+        entity_id=eid,
+        properties=DataMap(props or {}),
+        event_time=t,
+        event_id=event_id,
+    )
+
+
+class CleaningSource(SelfCleaningDataSource):
+    def __init__(self, app_name, window):
+        self.app_name = app_name
+        self._window = window
+
+    @property
+    def event_window(self):
+        return self._window
+
+
+class TestSelfCleaning:
+    def test_ttl_filter(self):
+        src = CleaningSource("x", EventWindow(duration_seconds=7 * 86400))
+        events = [
+            _ev("view", "u1", days_ago=1),
+            _ev("view", "u1", days_ago=30),
+            _ev("$set", "u1", {"a": 1}, days_ago=30),  # $set survives TTL
+        ]
+        cleaned = src.cleaned_events(events)
+        assert len(cleaned) == 2
+        assert {e.event for e in cleaned} == {"view", "$set"}
+
+    def test_compress_set_chain(self):
+        src = CleaningSource(
+            "x", EventWindow(compress_properties=True)
+        )
+        events = [
+            _ev("$set", "u1", {"a": 1, "b": 1}, days_ago=3),
+            _ev("$set", "u1", {"b": 2}, days_ago=2),
+            _ev("$unset", "u1", {"a": 1}, days_ago=1),
+            _ev("view", "u1"),
+        ]
+        cleaned = src.cleaned_events(events)
+        sets = [e for e in cleaned if e.event == "$set"]
+        assert len(sets) == 1
+        # the $set chain folds; the $unset stays a separate (later) event,
+        # exactly like the reference's compressPProperties
+        assert sets[0].properties.fields == {"a": 1, "b": 2}
+        assert len([e for e in cleaned if e.event == "$unset"]) == 1
+        assert len([e for e in cleaned if e.event == "view"]) == 1
+
+    def test_dedup(self):
+        src = CleaningSource("x", EventWindow(remove_duplicates=True))
+        e1 = _ev("view", "u1", days_ago=1)
+        events = [e1, dataclasses.replace(e1, event_id="other")]
+        assert len(src.cleaned_events(events)) == 1
+
+    def test_clean_persisted_events(self, storage):
+        d = cmd.app_new(storage, "cleanapp")
+        levents = storage.l_events()
+        old_set_1 = _ev("$set", "u1", {"a": 1}, days_ago=30)
+        old_set_2 = _ev("$set", "u1", {"b": 2}, days_ago=20)
+        recent_view = _ev("view", "u1", days_ago=1)
+        old_view = _ev("view", "u1", days_ago=30)
+        for e in (old_set_1, old_set_2, recent_view, old_view):
+            levents.insert(e, d.app.id)
+
+        src = CleaningSource(
+            "cleanapp",
+            EventWindow(duration_seconds=7 * 86400, compress_properties=True),
+        )
+        removed = src.clean_persisted_events(EngineContext(storage=storage))
+        assert removed >= 2  # old view + at least one compacted $set
+        remaining = list(levents.find(d.app.id))
+        sets = [e for e in remaining if e.event == "$set"]
+        assert len(sets) == 1
+        assert sets[0].properties.fields == {"a": 1, "b": 2}
+        views = [e for e in remaining if e.event == "view"]
+        assert len(views) == 1  # only the recent one
