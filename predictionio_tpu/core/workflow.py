@@ -94,7 +94,22 @@ def run_train(
         persistable = engine.make_persistent_models(
             ctx, engine_params, models, algos=algos
         )
-        storage.models().insert(instance.id, serialize_models(persistable))
+        # PersistentModel flavors save themselves; only a manifest is stored
+        # (Engine.makeSerializableModels:284 + PersistentModelManifest)
+        from predictionio_tpu.core.persistent_model import (
+            PersistentModel,
+            PersistentModelManifest,
+        )
+
+        stored = []
+        for a, m in zip(algos, persistable):
+            if isinstance(m, PersistentModel) and m.save(
+                instance.id, getattr(a, "params", None)
+            ):
+                stored.append(PersistentModelManifest(type(m).class_path()))
+            else:
+                stored.append(m)
+        storage.models().insert(instance.id, serialize_models(stored))
         done = instance.completed()
         instances.update(done)
         log.info("training finished: engine instance %s", instance.id)
@@ -106,6 +121,10 @@ def run_train(
             _dc.replace(instance, status="FAILED", end_time=_now())
         )
         raise
+    finally:
+        from predictionio_tpu.core.cleanup import run as _run_cleanups
+
+        _run_cleanups()
 
 
 def run_evaluation(
@@ -156,3 +175,7 @@ def run_evaluation(
 
         instances.update(_dc.replace(instance, status="FAILED", end_time=_now()))
         raise
+    finally:
+        from predictionio_tpu.core.cleanup import run as _run_cleanups
+
+        _run_cleanups()

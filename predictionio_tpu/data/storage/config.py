@@ -103,26 +103,35 @@ class StorageRuntime:
         self._clients: dict[str, Any] = {}
         self._lock = threading.RLock()
 
-    def _sqlite_client(self, name: str, props: dict[str, str]) -> SQLiteClient:
+    def _sql_client(self, name: str, props: dict[str, str]):
+        """A SQL client for a source: sqlite (embedded) or postgres."""
         with self._lock:
             if name not in self._clients:
-                path = props.get("PATH") or props.get("URL") or ":memory:"
-                client = SQLiteClient(path)
-                SQLiteMetadata(client)
+                typ = props.get("TYPE", "sqlite")
+                if typ == "sqlite":
+                    path = props.get("PATH") or props.get("URL") or ":memory:"
+                    client = SQLiteClient(path)
+                    SQLiteMetadata(client)
+                elif typ in ("postgres", "jdbc"):
+                    from predictionio_tpu.data.storage.postgres_backend import (
+                        make_client,
+                    )
+
+                    client = make_client(props.get("URL", ""))
+                else:
+                    raise StorageError(
+                        f"source {name} has unsupported SQL TYPE {typ!r}"
+                    )
                 self._clients[name] = client
             return self._clients[name]
 
-    def _meta_client(self) -> SQLiteClient:
+    def _meta_client(self):
         name, props = self.config.source_for("METADATA")
-        if props.get("TYPE", "sqlite") != "sqlite":
-            raise StorageError(f"METADATA requires a sqlite source, got {props}")
-        return self._sqlite_client(name, props)
+        return self._sql_client(name, props)
 
-    def _event_client(self) -> SQLiteClient:
+    def _event_client(self):
         name, props = self.config.source_for("EVENTDATA")
-        if props.get("TYPE", "sqlite") != "sqlite":
-            raise StorageError(f"EVENTDATA requires a sqlite source, got {props}")
-        return self._sqlite_client(name, props)
+        return self._sql_client(name, props)
 
     # -- metadata DAOs -------------------------------------------------------
     def apps(self) -> base.Apps:
@@ -145,8 +154,8 @@ class StorageRuntime:
         typ = props.get("TYPE", "sqlite")
         if typ == "localfs":
             return LocalFSModels(props.get("PATH", str(self.config.home / "models")))
-        if typ == "sqlite":
-            return SQLiteModels(self._sqlite_client(name, props))
+        if typ in ("sqlite", "postgres", "jdbc"):
+            return SQLiteModels(self._sql_client(name, props))
         raise StorageError(f"unsupported MODELDATA source type {typ!r}")
 
     # -- event DAOs (cached: the DAO keeps a known-tables set so the serving

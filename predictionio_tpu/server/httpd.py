@@ -156,11 +156,36 @@ def _make_handler_class(app: HTTPApp):
 
 
 class AppServer:
-    """Bind an HTTPApp on host:port with a background serve thread."""
+    """Bind an HTTPApp on host:port with a background serve thread.
 
-    def __init__(self, app: HTTPApp, host: str = "0.0.0.0", port: int = 7070):
+    TLS (the reference's SSLConfiguration/server.conf role,
+    common/.../configuration/SSLConfiguration.scala:28) comes from the
+    ``PIO_SSL_CERTFILE``/``PIO_SSL_KEYFILE`` env vars or explicit paths —
+    PEM files instead of a JKS keystore.
+    """
+
+    def __init__(
+        self,
+        app: HTTPApp,
+        host: str = "0.0.0.0",
+        port: int = 7070,
+        ssl_certfile: str | None = None,
+        ssl_keyfile: str | None = None,
+    ):
+        import os
+
         self.app = app
         self.httpd = ThreadingHTTPServer((host, port), _make_handler_class(app))
+        certfile = ssl_certfile or os.environ.get("PIO_SSL_CERTFILE")
+        keyfile = ssl_keyfile or os.environ.get("PIO_SSL_KEYFILE")
+        if certfile:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile)
+            self.httpd.socket = ctx.wrap_socket(
+                self.httpd.socket, server_side=True
+            )
         self.host, self.port = self.httpd.server_address[:2]
         self._thread: threading.Thread | None = None
 

@@ -110,7 +110,9 @@ class DeployedEngine:
                 f"no model blob for engine instance {instance.id}"
             )
         persisted = deserialize_models(blob)
-        models = self.engine.prepare_deploy(self.ctx, params, persisted)
+        models = self.engine.prepare_deploy(
+            self.ctx, params, persisted, instance_id=instance.id
+        )
         _, _, algos, serving = self.engine.instantiate(params)
         with self._lock:
             self.instance = instance

@@ -141,7 +141,8 @@ def do_import(args) -> int:
 
 def do_export(args) -> int:
     n = cmd.export_events(
-        get_storage(), args.app, args.output, channel=args.channel
+        get_storage(), args.app, args.output, channel=args.channel,
+        format=args.format,
     )
     print(f"Exported {n} events.")
     return 0
@@ -332,11 +333,76 @@ def do_run(args) -> int:
     return 0
 
 
+#: starter engine.json written by `template get <name> <dir>`
+_TEMPLATE_VARIANTS = {
+    "recommendation": {
+        "engineFactory": "recommendation",
+        "datasource": {"params": {"appName": "MyApp"}},
+        "algorithms": [
+            {
+                "name": "als",
+                "params": {"rank": 10, "numIterations": 20, "lambda": 0.01,
+                           "seed": 3},
+            }
+        ],
+    },
+    "similarproduct": {
+        "engineFactory": "similarproduct",
+        "datasource": {"params": {"appName": "MyApp", "eventNames": ["view"]}},
+        "algorithms": [
+            {"name": "als",
+             "params": {"rank": 10, "numIterations": 20, "lambda": 0.01}}
+        ],
+    },
+    "classification": {
+        "engineFactory": "classification",
+        "datasource": {"params": {"appName": "MyApp"}},
+        "algorithms": [{"name": "naive", "params": {"lambda": 1.0}}],
+    },
+    "ecommerce": {
+        "engineFactory": "ecommerce",
+        "datasource": {"params": {"appName": "MyApp"}},
+        "algorithms": [
+            {"name": "ecomm",
+             "params": {"appName": "MyApp", "rank": 10, "numIterations": 20}}
+        ],
+    },
+    "ncf": {
+        "engineFactory": "ncf",
+        "datasource": {"params": {"appName": "MyApp"}},
+        "algorithms": [
+            {"name": "ncf",
+             "params": {"embedDim": 32, "mlpLayers": [64, 32, 16],
+                        "numEpochs": 5}}
+        ],
+    },
+}
+
+
 def do_template(args) -> int:
-    """`pio template list`: bundled engine templates (Template.scala:35)."""
+    """`pio template list/get` (Template.scala:35): list bundled engines or
+    scaffold an engine.json for one."""
     from predictionio_tpu.core.engine import engine_registry
 
     _load_engine_modules()
+    if args.template_command == "get":
+        if not args.name or args.name not in _TEMPLATE_VARIANTS:
+            raise CommandError(
+                f"unknown template {args.name!r}; have "
+                f"{sorted(_TEMPLATE_VARIANTS)}"
+            )
+        target = Path(args.directory or args.name)
+        out_file = target / "engine.json"
+        if out_file.exists():
+            raise CommandError(
+                f"{out_file} already exists — refusing to overwrite"
+            )
+        target.mkdir(parents=True, exist_ok=True)
+        out_file.write_text(
+            json.dumps(_TEMPLATE_VARIANTS[args.name], indent=2) + "\n"
+        )
+        print(f"Wrote {out_file}")
+        return 0
     _print(
         {
             "bundled": engine_registry.names(),
@@ -344,6 +410,22 @@ def do_template(args) -> int:
             "path 'pkg.module:factory' for custom engines",
         }
     )
+    return 0
+
+
+def do_build(args) -> int:
+    """`pio build` parity: engines are plain Python — nothing to compile.
+    Validates the engine.json instead (the useful part of the verb)."""
+    try:
+        if args.engine_json and not Path(args.engine_json).exists():
+            raise CommandError(f"engine variant file {args.engine_json!r} not found")
+        factory_name, engine, variant = _resolve_engine(args)
+        engine.params_from_json(variant)
+    except Exception as e:
+        print(f"engine variant is invalid: {e}", file=sys.stderr)
+        return 1
+    print(f"Engine {factory_name!r} OK (no build step needed; XLA compiles "
+          "at first run and caches).")
     return 0
 
 
@@ -401,6 +483,7 @@ def build_parser() -> argparse.ArgumentParser:
     exp.add_argument("--app", required=True, dest="app")
     exp.add_argument("--output", required=True)
     exp.add_argument("--channel")
+    exp.add_argument("--format", choices=["json", "parquet"], default="json")
     exp.set_defaults(fn=do_export)
 
     def engine_flags(sp, variant_default="default"):
@@ -471,8 +554,17 @@ def build_parser() -> argparse.ArgumentParser:
     rn.set_defaults(fn=do_run)
 
     tp = sub.add_parser("template")
-    tp.add_argument("template_command", choices=["list"], nargs="?", default="list")
+    tp.add_argument(
+        "template_command", choices=["list", "get"], nargs="?", default="list"
+    )
+    tp.add_argument("name", nargs="?")
+    tp.add_argument("directory", nargs="?")
     tp.set_defaults(fn=do_template)
+
+    bd = sub.add_parser("build")
+    bd.add_argument("--engine")
+    bd.add_argument("--engine-json", default="engine.json")
+    bd.set_defaults(fn=do_build)
 
     return p
 

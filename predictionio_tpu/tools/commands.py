@@ -252,15 +252,35 @@ def export_events(
     app_name: str,
     output_path: str | Path,
     channel: str | None = None,
+    format: str = "json",
 ) -> int:
-    """Event store -> JSON-lines file (export/EventsToFile.scala:42)."""
+    """Event store -> JSON-lines or parquet file
+    (export/EventsToFile.scala:42 supports the same two formats)."""
     app = _require_app(storage, app_name)
     channel_id = (
         _require_channel(storage, app, channel).id if channel is not None else None
     )
-    n = 0
+    rows = [
+        e.to_api_dict() for e in storage.l_events().find(app.id, channel_id)
+    ]
+    if format == "parquet":
+        try:
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+        except ImportError:
+            raise CommandError(
+                "parquet export requires pyarrow; use --format json"
+            ) from None
+
+        # properties nest arbitrarily: store them as a JSON string column
+        flat = [
+            {**r, "properties": json.dumps(r.get("properties", {}))} for r in rows
+        ]
+        pq.write_table(pa.Table.from_pylist(flat), str(output_path))
+        return len(flat)
+    if format != "json":
+        raise CommandError(f"unsupported export format {format!r}")
     with open(output_path, "w") as out:
-        for e in storage.l_events().find(app.id, channel_id):
-            out.write(json.dumps(e.to_api_dict()) + "\n")
-            n += 1
-    return n
+        for r in rows:
+            out.write(json.dumps(r) + "\n")
+    return len(rows)

@@ -1,0 +1,358 @@
+"""Parity-sweep tests: EntityMap, cleanup hooks, persistent models, SSL,
+parquet export, postgres dialect translation, new CLI verbs."""
+
+import json
+import pickle
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.tools.cli import main as cli_main
+
+
+@pytest.fixture(autouse=True)
+def global_storage(storage):
+    return storage
+
+
+class TestEntityMap:
+    def test_lookup_both_ways(self):
+        from predictionio_tpu.data.entity_map import EntityMap
+
+        em = EntityMap({"b": 2, "a": 1, "c": 3})
+        assert len(em) == 3
+        assert em["a"] == 1
+        idx = em.index_of("a")
+        assert em.entity_id_of(idx) == "a"
+        assert em.by_index(idx) == 1
+        assert "a" in em and "z" not in em
+        assert em.get("z") is None
+
+
+class TestCleanup:
+    def test_hooks_run_after_train(self, storage, tmp_path):
+        from predictionio_tpu.core import cleanup
+        from predictionio_tpu.core.base import EngineContext
+        from predictionio_tpu.core.workflow import run_train
+        from predictionio_tpu.tools import commands as cmd
+        from tests.test_templates import _insert, _interaction
+
+        d = cmd.app_new(storage, "cleanuped")
+        _insert(
+            storage,
+            d.app.id,
+            [
+                _interaction("rate", f"u{i}", "i0", {"rating": 5.0})
+                for i in range(5)
+            ],
+        )
+        calls = []
+        cleanup.add(lambda: calls.append("ran"))
+
+        from predictionio_tpu.models.recommendation import recommendation_engine
+
+        engine = recommendation_engine()
+        params = engine.params_from_json(
+            {
+                "datasource": {"params": {"appName": "cleanuped"}},
+                "algorithms": [
+                    {"name": "als", "params": {"rank": 2, "numIterations": 1}}
+                ],
+            }
+        )
+        run_train(engine, params, ctx=EngineContext(storage=storage),
+                  storage=storage, engine_factory="recommendation")
+        assert calls == ["ran"]
+
+    def test_failures_do_not_block_other_hooks(self):
+        from predictionio_tpu.core import cleanup
+
+        calls = []
+        cleanup.add(lambda: calls.append(1))
+        cleanup.add(lambda: 1 / 0)
+        cleanup.run()
+        assert calls == [1]
+        cleanup.run()  # cleared
+        assert calls == [1]
+
+
+class _PickleModel:
+    """Payload stored via LocalFileSystemPersistentModel."""
+
+
+class TestPersistentModel:
+    def test_local_fs_roundtrip(self, tmp_path, monkeypatch):
+        LocalModel.base_dir = str(tmp_path)
+        m = LocalModel(weights=[1.0, 2.0])
+        assert m.save("inst42", None)
+        loaded = LocalModel.load("inst42", None)
+        assert loaded.weights == [1.0, 2.0]
+
+    def test_workflow_stores_manifest(self, storage, tmp_path):
+        """A PersistentModel-flavored model persists itself; the model store
+        keeps only the manifest; deploy reloads through it."""
+        import predictionio_tpu.core.persistent_model as pm
+        from predictionio_tpu.core.base import EngineContext
+        from predictionio_tpu.core.engine import SimpleEngine
+        from predictionio_tpu.core.persistence import deserialize_models
+        from predictionio_tpu.core.workflow import run_train
+
+        tests_mod_model = SelfSavingModel
+        SelfSavingModel.base_dir = str(tmp_path)
+
+        from predictionio_tpu.core.base import Algorithm, DataSource
+
+        class DS(DataSource):
+            def read_training(self, ctx):
+                return [1, 2, 3]
+
+        class Algo(Algorithm):
+            def train(self, ctx, pd):
+                return SelfSavingModel(total=sum(pd))
+
+            def predict(self, model, q):
+                return model.total
+
+        engine = SimpleEngine(DS, Algo)
+        params = engine.params_from_json({})
+        instance = run_train(
+            engine, params, ctx=EngineContext(storage=storage), storage=storage
+        )
+        blob = storage.models().get(instance.id)
+        (stored,) = deserialize_models(blob)
+        assert isinstance(stored, pm.PersistentModelManifest)
+        models = engine.prepare_deploy(
+            EngineContext(storage=storage), params, [stored],
+            instance_id=instance.id,
+        )
+        assert models[0].total == 6
+
+
+from predictionio_tpu.core.persistent_model import (  # noqa: E402
+    LocalFileSystemPersistentModel,
+)
+
+
+class LocalModel(LocalFileSystemPersistentModel):
+    """Module-level so pickle can resolve it."""
+
+    base_dir = None
+
+    def __init__(self, weights):
+        self.weights = weights
+
+
+class SelfSavingModel:
+    """Module-level so the manifest class path is importable."""
+
+    base_dir = None
+
+    def __init__(self, total):
+        self.total = total
+
+    def save(self, instance_id, params):
+        import pickle
+        from pathlib import Path
+
+        p = Path(self.base_dir) / f"{instance_id}.pkl"
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(pickle.dumps(self.total))
+        return True
+
+    @classmethod
+    def load(cls, instance_id, params):
+        import pickle
+        from pathlib import Path
+
+        return cls(total=pickle.loads(
+            (Path(cls.base_dir) / f"{instance_id}.pkl").read_bytes()
+        ))
+
+    @classmethod
+    def class_path(cls):
+        return f"{cls.__module__}:{cls.__qualname__}"
+
+
+# register as a PersistentModel structurally
+from predictionio_tpu.core.persistent_model import PersistentModel  # noqa: E402
+
+PersistentModel.register(SelfSavingModel)
+
+
+class TestParquetExport:
+    def test_roundtrip(self, storage, tmp_path, capsys):
+        import pyarrow.parquet as pq
+
+        cli_main(["app", "new", "pqapp"])
+        capsys.readouterr()
+        src = tmp_path / "in.jsonl"
+        src.write_text(
+            "\n".join(
+                json.dumps(
+                    {
+                        "event": "rate",
+                        "entityType": "user",
+                        "entityId": f"u{i}",
+                        "targetEntityType": "item",
+                        "targetEntityId": "i0",
+                        "properties": {"rating": 5.0, "tags": ["a", "b"]},
+                    }
+                )
+                for i in range(4)
+            )
+        )
+        assert cli_main(["import", "--app", "pqapp", "--input", str(src)]) == 0
+        out = tmp_path / "out.parquet"
+        assert (
+            cli_main(
+                ["export", "--app", "pqapp", "--output", str(out),
+                 "--format", "parquet"]
+            )
+            == 0
+        )
+        table = pq.read_table(out)
+        assert table.num_rows == 4
+        props = json.loads(table.to_pylist()[0]["properties"])
+        assert props["rating"] == 5.0 and props["tags"] == ["a", "b"]
+
+
+class TestPostgresDialect:
+    def test_translate(self):
+        from predictionio_tpu.data.storage.postgres_backend import _translate
+
+        out = _translate(
+            "INSERT OR REPLACE INTO pio_models (id, models) VALUES (?, ?)"
+        )
+        assert out.startswith("INSERT INTO pio_models (id, models)")
+        assert "ON CONFLICT (id) DO UPDATE SET models = EXCLUDED.models" in out
+        assert "%s, %s" in out
+
+        out = _translate(
+            "CREATE TABLE IF NOT EXISTS pio_apps (id INTEGER PRIMARY KEY "
+            "AUTOINCREMENT, name TEXT)"
+        )
+        assert "BIGSERIAL PRIMARY KEY" in out
+
+        out = _translate("INSERT INTO pio_apps (name, description) VALUES (?, ?)")
+        assert out.endswith("RETURNING id")
+
+    def test_missing_driver_message(self):
+        from predictionio_tpu.data.storage.postgres_backend import PGClient
+
+        with pytest.raises(ImportError, match="psycopg"):
+            PGClient("postgresql://nope/nope")
+
+
+class TestSSL:
+    def test_https_serving(self, tmp_path):
+        """AppServer with a self-signed cert answers over TLS."""
+        import ssl
+        import subprocess
+        import urllib.request
+
+        cert = tmp_path / "cert.pem"
+        key = tmp_path / "key.pem"
+        subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "rsa:2048",
+                "-keyout", str(key), "-out", str(cert), "-days", "1",
+                "-nodes", "-subj", "/CN=localhost",
+            ],
+            check=True,
+            capture_output=True,
+        )
+        from predictionio_tpu.server.httpd import AppServer, HTTPApp, Response
+
+        app = HTTPApp("ssltest")
+
+        @app.route("GET", "/")
+        def index(req):
+            return Response(200, {"secure": True})
+
+        server = AppServer(
+            app, host="127.0.0.1", port=0,
+            ssl_certfile=str(cert), ssl_keyfile=str(key),
+        ).start_background()
+        try:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            with urllib.request.urlopen(
+                f"https://127.0.0.1:{server.port}/", context=ctx, timeout=5
+            ) as r:
+                assert json.loads(r.read())["secure"] is True
+        finally:
+            server.shutdown()
+
+
+class TestNewCLIVerbs:
+    def test_template_get_and_build(self, tmp_path, capsys, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        assert cli_main(["template", "get", "recommendation", "myengine"]) == 0
+        engine_json = tmp_path / "myengine" / "engine.json"
+        assert engine_json.exists()
+        capsys.readouterr()
+        assert (
+            cli_main(["build", "--engine-json", str(engine_json)]) == 0
+        )
+        assert "OK" in capsys.readouterr().out
+
+    def test_build_rejects_bad_variant(self, tmp_path, capsys):
+        bad = tmp_path / "engine.json"
+        bad.write_text(json.dumps({
+            "engineFactory": "recommendation",
+            "algorithms": [{"name": "als", "params": {"nope": 1}}],
+        }))
+        assert cli_main(["build", "--engine-json", str(bad)]) == 1
+
+
+class TestReviewFixes:
+    def test_build_missing_file_errors(self, capsys):
+        assert cli_main(["build", "--engine-json", "/nope/engine.json"]) == 1
+
+    def test_template_get_refuses_overwrite(self, tmp_path, monkeypatch, capsys):
+        monkeypatch.chdir(tmp_path)
+        assert cli_main(["template", "get", "ncf", "d"]) == 0
+        capsys.readouterr()
+        assert cli_main(["template", "get", "ncf", "d"]) == 1
+        assert "refusing" in capsys.readouterr().err
+
+    def test_persistent_save_gets_algo_params(self, storage, tmp_path):
+        """save() receives the algorithm's params (symmetry with load)."""
+        from predictionio_tpu.core.base import Algorithm, DataSource, EngineContext
+        from predictionio_tpu.core.engine import SimpleEngine
+        from predictionio_tpu.core.workflow import run_train
+
+        seen = {}
+        SelfSavingModel.base_dir = str(tmp_path)
+        orig_save = SelfSavingModel.save
+
+        def spy_save(self, instance_id, params):
+            seen["params"] = params
+            return orig_save(self, instance_id, params)
+
+        SelfSavingModel.save = spy_save
+        try:
+            class DS(DataSource):
+                def read_training(self, ctx):
+                    return [1]
+
+            class Algo(Algorithm):
+                def __init__(self, params=None):
+                    self.params = {"marker": 7}
+
+                def train(self, ctx, pd):
+                    return SelfSavingModel(total=1)
+
+                def predict(self, model, q):
+                    return model.total
+
+            run_train(
+                SimpleEngine(DS, Algo),
+                SimpleEngine(DS, Algo).params_from_json({}),
+                ctx=EngineContext(storage=storage),
+                storage=storage,
+            )
+            assert seen["params"] == {"marker": 7}
+        finally:
+            SelfSavingModel.save = orig_save
