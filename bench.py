@@ -74,9 +74,28 @@ def main() -> None:
 
     import sys
 
+    # secondary: serving-path p50 (the /queries.json compute core — masked
+    # top-k over every item for one user) on the trained factors
+    import jax.numpy as jnp
+
+    from predictionio_tpu.models.recommendation.engine import _topk_for_user_idx
+
+    U = jnp.asarray(state.user_factors)
+    V = jnp.asarray(state.item_factors)
+    lat = []
+    _ = jax.block_until_ready(_topk_for_user_idx(U, V, jnp.int32(0), 10))
+    for q in range(200):
+        t0 = time.perf_counter()
+        jax.block_until_ready(
+            _topk_for_user_idx(U, V, jnp.int32(q % num_users), 10)
+        )
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    p50_ms = lat[len(lat) // 2] * 1000
+
     print(
         f"# platform={platform} devices={n_dev} nnz={nnz} "
-        f"warmup(compile+1ep)={warm_s:.2f}s",
+        f"warmup(compile+1ep)={warm_s:.2f}s serving_topk_p50={p50_ms:.3f}ms",
         file=sys.stderr,
     )
     print(

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Cron-able retrain + hot-swap loop (reference examples/redeploy-script/
+# redeploy.sh).  Trains a fresh engine instance, then POSTs /reload so the
+# running prediction server swaps to it with no downtime.
+set -euo pipefail
+
+ENGINE_JSON=${1:-engine.json}
+HOST=${2:-127.0.0.1}
+PORT=${3:-8000}
+
+python -m predictionio_tpu.tools.cli train --engine-json "$ENGINE_JSON"
+curl -fsS -X POST "http://${HOST}:${PORT}/reload"
+echo "redeployed $(date -Is)"

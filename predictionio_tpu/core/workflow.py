@@ -127,6 +127,51 @@ def run_train(
         _run_cleanups()
 
 
+def run_fake(
+    fn: Callable[[EngineContext], Any],
+    ctx: EngineContext | None = None,
+    storage: StorageRuntime | None = None,
+    label: str = "FakeWorkflow",
+) -> Any:
+    """Run an arbitrary function through the workflow plumbing
+    (workflow/FakeWorkflow.scala:33-108): an EvaluationInstance records the
+    run (EVALCOMPLETED/FAILED), cleanups fire, the function's return value
+    comes back.  The reference uses this to script failure scenarios in
+    tests; it doubles as a way to run ad-hoc jobs with workflow bookkeeping.
+    """
+    storage = storage or get_storage()
+    ctx = ctx or EngineContext(storage=storage, mode="eval")
+    instances = storage.evaluation_instances()
+    instance = EvaluationInstance(
+        id=uuid.uuid4().hex,
+        status="EVALUATING",
+        start_time=_now(),
+        end_time=_now(),
+        evaluation_class=label,
+    )
+    instances.insert(instance)
+    import dataclasses as _dc
+
+    try:
+        result = fn(ctx)
+        instances.update(
+            _dc.replace(
+                instance,
+                status="EVALCOMPLETED",
+                end_time=_now(),
+                evaluator_results=f"{label} completed",
+            )
+        )
+        return result
+    except Exception:
+        instances.update(_dc.replace(instance, status="FAILED", end_time=_now()))
+        raise
+    finally:
+        from predictionio_tpu.core.cleanup import run as _run_cleanups
+
+        _run_cleanups()
+
+
 def run_evaluation(
     engine: Engine,
     engine_params_list: Sequence[EngineParams],

@@ -211,7 +211,7 @@ class ALSAlgorithmParams:
     num_iterations: int = 20
     reg: float = 0.01
     seed: int = 3
-    chunk_size: int = 1 << 16
+    chunk_size: int = 1 << 19
 
     # reference engine.json spellings (customize-serving/engine.json:14-21)
     params_aliases = {"lambda": "reg", "numIterations": "num_iterations"}
@@ -236,6 +236,15 @@ class ALSModel:
 def _topk_for_user(user_vec, item_factors, exclude_mask, k):
     scores = item_factors @ user_vec  # [num_items] — single MXU matvec
     scores = jnp.where(exclude_mask, -jnp.inf, scores)
+    return jax.lax.top_k(scores, k)
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _topk_for_user_idx(user_factors, item_factors, user_idx, k):
+    """The whole serving hot path in ONE dispatch: row gather + matvec +
+    top-k.  Separate gather/score calls each pay a host->device round trip,
+    which dominates p50 on tunneled or remote devices."""
+    scores = item_factors @ user_factors[user_idx]
     return jax.lax.top_k(scores, k)
 
 
@@ -284,12 +293,8 @@ class ALSAlgorithm(Algorithm):
             return PredictedResult()  # unknown user (reference returns empty)
         n_items = len(model.item_vocab)
         k = min(query.num, n_items)
-        no_exclude = jnp.zeros((np.asarray(model.item_factors).shape[0],), bool)
-        scores, idx = _topk_for_user(
-            jnp.asarray(model.user_factors)[uidx],
-            jnp.asarray(model.item_factors),
-            no_exclude,
-            k,
+        scores, idx = _topk_for_user_idx(
+            model.user_factors, model.item_factors, jnp.int32(uidx), k
         )
         scores = np.asarray(scores)
         idx = np.asarray(idx)

@@ -75,6 +75,39 @@ def default_mesh() -> Mesh:
     return make_mesh(MeshConfig())
 
 
+def make_hybrid_mesh(
+    ici_axes: Mapping[str, int] | None = None,
+    dcn_axes: Mapping[str, int] | None = None,
+) -> Mesh:
+    """DCN-aware multi-slice mesh (the scaling-book recipe).
+
+    Inner (``ici_axes``) dimensions map onto the fast intra-slice fabric,
+    outer (``dcn_axes``) dimensions across slices over the data-center
+    network — so bandwidth-hungry collectives (model-axis all-gathers,
+    data-axis psums within a batch shard) ride ICI while only the
+    low-frequency cross-slice reductions cross DCN.  Defaults: pure data
+    parallelism across processes, all local devices on ``data``.
+    """
+    from jax.experimental import mesh_utils
+
+    n_processes = jax.process_count()
+    local = jax.local_device_count()
+    ici = dict(ici_axes or {"data": local, "model": 1})
+    dcn = dict(dcn_axes or {"data": n_processes, "model": 1})
+    names = tuple(ici)
+    if tuple(dcn) != names:
+        raise ValueError(f"ici/dcn axis names must match: {names} vs {tuple(dcn)}")
+    if n_processes == 1:
+        # single host: collapse to a plain mesh with the combined shape
+        sizes = {k: ici[k] * dcn[k] for k in names}
+        return make_mesh(MeshConfig(axes=sizes))
+    devices = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=[ici[k] for k in names],
+        dcn_mesh_shape=[dcn[k] for k in names],
+    )
+    return Mesh(devices, axis_names=names)
+
+
 def named_sharding(mesh: Mesh, *spec: str | None) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(*spec))
 

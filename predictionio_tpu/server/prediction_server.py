@@ -362,6 +362,26 @@ def deploy_engine(
     return DeployedEngine(engine, instance, storage)
 
 
+def undeploy_stale(host: str, port: int, access_key: str | None = None) -> bool:
+    """POST /stop to whatever serves on (host, port) before binding — the
+    MasterActor's undeploy-then-bind behavior (CreateServer.scala:281-306)."""
+    import urllib.request
+
+    probe_host = "127.0.0.1" if host in ("0.0.0.0", "") else host
+    url = f"http://{probe_host}:{port}/stop"
+    if access_key:
+        url += f"?accessKey={access_key}"
+    try:
+        with urllib.request.urlopen(
+            urllib.request.Request(url, method="POST"), timeout=3
+        ):
+            pass
+        time.sleep(0.5)  # give the old server a beat to release the port
+        return True
+    except Exception:
+        return False
+
+
 def create_prediction_server(
     engine_factory_name: str,
     host: str = "0.0.0.0",
@@ -374,6 +394,9 @@ def create_prediction_server(
     feedback: FeedbackConfig | None = None,
     access_key: str | None = None,
 ) -> AppServer:
+    if port:
+        if undeploy_stale(host, port, access_key):
+            log.info("undeployed stale server on port %d", port)
     deployed = deploy_engine(
         engine_factory_name,
         storage=storage,

@@ -165,3 +165,48 @@ class TestSelfCleaning:
         assert sets[0].properties.fields == {"a": 1, "b": 2}
         views = [e for e in remaining if e.event == "view"]
         assert len(views) == 1  # only the recent one
+
+
+class TestFakeWorkflow:
+    def test_records_completion(self, storage):
+        from predictionio_tpu.core.workflow import run_fake
+
+        out = run_fake(lambda ctx: 42, storage=storage, label="MyFake")
+        assert out == 42
+        (inst,) = storage.evaluation_instances().get_completed()
+        assert inst.evaluation_class == "MyFake"
+
+    def test_records_failure(self, storage):
+        from predictionio_tpu.core.workflow import run_fake
+
+        with pytest.raises(RuntimeError):
+            run_fake(lambda ctx: (_ for _ in ()).throw(RuntimeError("x")),
+                     storage=storage)
+        rows = storage.evaluation_instances().get_all()
+        assert rows and rows[0].status == "FAILED"
+
+
+class TestUndeployStale:
+    def test_stops_existing_server(self):
+        from predictionio_tpu.server.httpd import AppServer, HTTPApp, Response
+        from predictionio_tpu.server.prediction_server import undeploy_stale
+
+        app = HTTPApp("stale")
+        stopped = []
+
+        @app.route("POST", "/stop")
+        def stop(req):
+            stopped.append(True)
+            return Response(200, {"message": "Shutting down."})
+
+        server = AppServer(app, host="127.0.0.1", port=0).start_background()
+        try:
+            assert undeploy_stale("127.0.0.1", server.port) is True
+            assert stopped == [True]
+        finally:
+            server.shutdown()
+
+    def test_no_server_is_fine(self):
+        from predictionio_tpu.server.prediction_server import undeploy_stale
+
+        assert undeploy_stale("127.0.0.1", 1) is False

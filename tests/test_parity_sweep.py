@@ -356,3 +356,21 @@ class TestReviewFixes:
             assert seen["params"] == {"marker": 7}
         finally:
             SelfSavingModel.save = orig_save
+
+
+class TestHybridMesh:
+    def test_single_host_collapse(self):
+        from predictionio_tpu.parallel.mesh import make_hybrid_mesh
+
+        mesh = make_hybrid_mesh(
+            ici_axes={"data": 4, "model": 2}, dcn_axes={"data": 1, "model": 1}
+        )
+        assert dict(mesh.shape) == {"data": 4, "model": 2}
+
+    def test_axis_name_mismatch(self):
+        from predictionio_tpu.parallel.mesh import make_hybrid_mesh
+
+        with pytest.raises(ValueError, match="axis names must match"):
+            make_hybrid_mesh(
+                ici_axes={"data": 2}, dcn_axes={"replica": 1}
+            )
