@@ -1,29 +1,285 @@
-"""Headline benchmark: ALS full train at MovieLens-20M scale.
+"""Headline benchmark: ALS full train at MovieLens-20M scale + quality +
+serving latency.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "s", "vs_baseline": N,
+   "map_at_10": ..., "precision_at_10": ...,
+   "serving_p50_ms": ..., "serving_p50_concurrent32_ms": ...}
 
-The reference publishes no benchmark numbers (SURVEY.md §6), so the baseline
-is the driver-set north-star from BASELINE.json: full ALS train on
-MovieLens-20M in < 60 s on a TPU v5e-8 (reference hyperparams rank=10,
-20 iterations, lambda=0.01 — examples/scala-parallel-recommendation/
-customize-serving/engine.json:14-21).  ``vs_baseline`` is the speedup vs that
-60 s budget (>1.0 = beating the target).
+The reference publishes no benchmark numbers (SURVEY.md §6); the baseline is
+the driver-set north-star from BASELINE.json: full ALS train on
+MovieLens-20M in < 60 s (reference hyperparams rank=10, 20 iterations,
+lambda=0.01 — examples/scala-parallel-recommendation/customize-serving/
+engine.json:14-21) and /queries.json p50 < 10 ms.  ``vs_baseline`` is the
+speedup vs the 60 s budget (>1.0 = beating the target).
 
-Ratings are synthetic at the ML-20M shape (20M ratings, ~138k users, ~27k
-items) generated host-side; the timed region is the full train loop
-(compile excluded by a one-iteration warmup, which also measures epoch cost).
-On non-TPU hosts (CI smoke) the problem is scaled down and the budget scaled
-with it, so the line stays comparable in spirit.
+Zero-egress environment -> the dataset is a DETERMINISTIC MovieLens-like
+generator at the ML-20M shape (20M ratings, 138k users, 27k items): Zipf
+item popularity, heavy-tailed user activity, planted low-rank preference
+structure + noise, ratings clipped to the 0.5-5 star scale.  A held-out
+split (random ~3% of high ratings from active users) feeds MAP@10 /
+Precision@10 computed through the framework's Metric classes
+(models/recommendation/evaluation.py), vs the reference's Evaluation.scala
+PrecisionAtK protocol.
+
+Serving latency is measured twice:
+  - single-query p50 through ALSAlgorithm.predict (the engine hot path:
+    vocab lookup + host-replica top-k, the P2L local-model pattern);
+  - p50 under 32 concurrent clients against a real AsyncAppServer running
+    the micro-batched /queries.json route (HTTP + JSON + coalescing
+    included).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
+
+RANK_PLANTED = 8
+K = 10
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_movielens_like(nnz: int, num_users: int, num_items: int, seed: int = 3):
+    """Deterministic ML-shaped ratings (COO): Zipf item exposure, lognormal
+    user activity, item quality correlated with popularity (as in real
+    MovieLens), planted rank-8 personal preference structure + noise."""
+    rng = np.random.default_rng(seed)
+    item_p = (np.arange(num_items) + 10.0) ** -0.8
+    item_p /= item_p.sum()
+    user_w = rng.lognormal(0.0, 1.0, num_users)
+    user_p = user_w / user_w.sum()
+    user_idx = rng.choice(num_users, nnz, p=user_p).astype(np.int64)
+    item_idx = rng.choice(num_items, nnz, p=item_p).astype(np.int64)
+    uf = rng.standard_normal((num_users, RANK_PLANTED)).astype(np.float32)
+    vf = rng.standard_normal((num_items, RANK_PLANTED)).astype(np.float32)
+    zpop = -np.log(np.arange(num_items) + 10.0)
+    zpop = (zpop - zpop.mean()) / zpop.std()
+    item_bias = (
+        0.3 * zpop + 0.2 * rng.standard_normal(num_items)
+    ).astype(np.float32)
+    raw = (
+        3.1
+        + item_bias[item_idx]
+        + 1.8
+        * np.einsum("nk,nk->n", uf[user_idx], vf[item_idx])
+        / np.sqrt(RANK_PLANTED)
+        + 0.4 * rng.standard_normal(nnz).astype(np.float32)
+    )
+    rating = np.clip(np.round(raw * 2.0) / 2.0, 0.5, 5.0).astype(np.float32)
+    return user_idx, item_idx, rating
+
+
+def holdout_split(user_idx, item_idx, rating, rng, min_count=15, frac=0.03):
+    """Move a random slice of high ratings from active users to a test set."""
+    counts = np.bincount(user_idx, minlength=user_idx.max() + 1)
+    test_mask = (
+        (counts[user_idx] >= min_count)
+        & (rating >= 4.0)
+        & (rng.uniform(size=len(rating)) < frac)
+    )
+    train = ~test_mask
+    return (
+        (user_idx[train], item_idx[train], rating[train]),
+        (user_idx[test_mask], item_idx[test_mask]),
+    )
+
+
+def compute_ranking_metrics(
+    U, V, train_u, train_i, test_u, test_i, max_eval_users=10_000, seed=0
+):
+    """MAP@10 / Precision@10 via the framework metrics, excluding each
+    user's train items from the ranking (reference blacklist protocol)."""
+    from predictionio_tpu.models.recommendation.engine import (
+        ItemScore,
+        PredictedResult,
+        Query,
+    )
+    from predictionio_tpu.models.recommendation.evaluation import (
+        MAPAtK,
+        PrecisionAtK,
+    )
+    from predictionio_tpu.ops.topk import host_topk_batch
+
+    rng = np.random.default_rng(seed)
+    eval_users = np.unique(test_u)
+    if len(eval_users) > max_eval_users:
+        eval_users = rng.choice(eval_users, max_eval_users, replace=False)
+        eval_users.sort()
+
+    # per-user index slices into the (sorted-by-user) train/test streams
+    train_order = np.argsort(train_u, kind="stable")
+    train_u_sorted = train_u[train_order]
+    train_i_sorted = train_i[train_order]
+    test_order = np.argsort(test_u, kind="stable")
+    test_u_sorted = test_u[test_order]
+    test_i_sorted = test_i[test_order]
+
+    Uh = np.asarray(U, np.float32)
+    Vh = np.asarray(V, np.float32)
+    triples = []
+    chunk = 2048
+    for c0 in range(0, len(eval_users), chunk):
+        users = eval_users[c0 : c0 + chunk]
+        scores = Uh[users] @ Vh.T  # [B, n_items]
+        t_lo = np.searchsorted(train_u_sorted, users, "left")
+        t_hi = np.searchsorted(train_u_sorted, users, "right")
+        for row, (u, lo, hi) in enumerate(zip(users, t_lo, t_hi)):
+            scores[row, train_i_sorted[lo:hi]] = -np.inf
+        top_s, top_i = host_topk_batch(scores, K)
+        e_lo = np.searchsorted(test_u_sorted, users, "left")
+        e_hi = np.searchsorted(test_u_sorted, users, "right")
+        for row, (u, lo, hi) in enumerate(zip(users, e_lo, e_hi)):
+            actual = frozenset(str(i) for i in test_i_sorted[lo:hi])
+            pred = PredictedResult(
+                item_scores=tuple(
+                    ItemScore(item=str(ii), score=float(ss))
+                    for ii, ss in zip(top_i[row], top_s[row])
+                )
+            )
+            triples.append((Query(user=str(u), num=K), pred, actual))
+    fold_data = [({}, triples)]
+    return (
+        MAPAtK(K).calculate(fold_data),
+        PrecisionAtK(K).calculate(fold_data),
+        len(triples),
+    )
+
+
+def build_als_model(state, num_users, num_items):
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.models.recommendation.engine import ALSModel
+
+    user_vocab = BiMap.from_keys(np.asarray([str(u) for u in range(num_users)]))
+    item_vocab = BiMap.from_keys(np.asarray([str(i) for i in range(num_items)]))
+    return ALSModel(
+        user_factors=np.asarray(state.user_factors),
+        item_factors=np.asarray(state.item_factors),
+        user_vocab=user_vocab,
+        item_vocab=item_vocab,
+    )
+
+
+def serving_p50_single(model, num_users, n=500):
+    """Engine-path solo-query p50: ALSAlgorithm.predict end to end."""
+    from predictionio_tpu.models.recommendation.engine import ALSAlgorithm, Query
+
+    algo = ALSAlgorithm()
+    algo.predict(model, Query(user="0", num=K))  # warm host replica
+    lat = []
+    for q in range(n):
+        t0 = time.perf_counter()
+        r = algo.predict(model, Query(user=str(q % num_users), num=K))
+        lat.append(time.perf_counter() - t0)
+        assert r.item_scores
+    lat.sort()
+    return lat[len(lat) // 2] * 1000
+
+
+_CLIENT_SCRIPT = r"""
+# Minimal asyncio load client: N keep-alive connections, pre-encoded request
+# bytes, hand-rolled response framing.  Load generation shares this box's
+# CPU with the server under test (single-core machine image), so every
+# microsecond of client overhead inflates the server's measured latency.
+import asyncio, json, sys, time
+port, conns, per_conn, num_users = (int(a) for a in sys.argv[1:5])
+
+def req_bytes(uid):
+    body = b'{"user": "%d", "num": 10}' % uid
+    return (b"POST /queries.json HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
+
+lats = []
+
+async def client(cid):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    for q in range(per_conn):
+        payload = req_bytes((cid * per_conn + q) % num_users)
+        t0 = time.perf_counter()
+        writer.write(payload)
+        head = await reader.readuntil(b"\r\n\r\n")
+        clen = int(head.lower().split(b"content-length:")[1].split(b"\r\n")[0])
+        body = await reader.readexactly(clen)
+        lats.append(time.perf_counter() - t0)
+        assert head.startswith(b"HTTP/1.1 200"), head[:80] + body[:200]
+    writer.close()
+
+async def main():
+    await asyncio.gather(*(client(c) for c in range(conns)))
+
+asyncio.run(main())
+lats.sort()
+print(json.dumps({"p50_ms": lats[len(lats) // 2] * 1000,
+                  "p99_ms": lats[int(len(lats) * 0.99)] * 1000}))
+"""
+
+
+def serving_p50_concurrent(model, num_users, clients=32, per_client=40):
+    """p50 across 32 concurrent keep-alive clients (a separate process, so
+    client-side GIL load doesn't pollute the measurement) hitting the real
+    asyncio server + micro-batched /queries.json route."""
+    import subprocess
+    import threading
+    import types
+
+    from predictionio_tpu.core.base import FirstServing
+    from predictionio_tpu.models.recommendation.engine import ALSAlgorithm
+    from predictionio_tpu.server.aio import AsyncAppServer
+    from predictionio_tpu.server.prediction_server import (
+        DeployedEngine,
+        create_prediction_server_app,
+    )
+
+    deployed = DeployedEngine.__new__(DeployedEngine)
+    deployed._lock = threading.RLock()
+    deployed.instance = types.SimpleNamespace(id="bench")
+    deployed.storage = None
+    deployed.algorithms = [ALSAlgorithm()]
+    deployed.models = [model]
+    deployed.serving = FirstServing()
+    app = create_prediction_server_app(deployed, use_microbatch=True)
+    server = AsyncAppServer(app, "127.0.0.1", 0).start_background()
+    n_procs = 1  # the asyncio client is cheap; more procs just burn the core
+    try:
+        procs = [
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    "-c",
+                    _CLIENT_SCRIPT,
+                    str(server.port),
+                    str(clients // n_procs),
+                    str(per_client),
+                    str(num_users),
+                ],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+            for _ in range(n_procs)
+        ]
+        p50s, p99s = [], []
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            if p.returncode != 0:
+                raise RuntimeError(f"bench client failed: {err[-500:]}")
+            r = json.loads(out.strip().splitlines()[-1])
+            p50s.append(r["p50_ms"])
+            p99s.append(r["p99_ms"])
+        sizes = sorted(app.microbatcher.wave_sizes.items())
+        log(f"# microbatch waves (size: count): {sizes}")
+        log(f"# concurrent p99={max(p99s):.3f}ms")
+        return sum(p50s) / len(p50s)
+    finally:
+        server.shutdown()
 
 
 def main() -> None:
@@ -41,24 +297,24 @@ def main() -> None:
     num_items = max(int(26_744 * scale), 48)
     budget_s = 60.0 * max(scale, 1e-6)
 
-    rng = np.random.default_rng(3)
-    user_idx = rng.integers(0, num_users, nnz, dtype=np.int64)
-    item_idx = rng.integers(0, num_items, nnz, dtype=np.int64)
-    # low-rank planted structure so the solves are numerically realistic
-    uf = rng.standard_normal((num_users, 4)).astype(np.float32)
-    vf = rng.standard_normal((num_items, 4)).astype(np.float32)
-    rating = np.clip(
-        2.5 + np.einsum("nk,nk->n", uf[user_idx], vf[item_idx]), 0.5, 5.0
-    ).astype(np.float32)
+    t0 = time.perf_counter()
+    user_idx, item_idx, rating = make_movielens_like(nnz, num_users, num_items)
+    (tr_u, tr_i, tr_r), (te_u, te_i) = holdout_split(
+        user_idx, item_idx, rating, np.random.default_rng(7)
+    )
+    log(
+        f"# platform={platform} devices={len(jax.devices())} nnz={nnz} "
+        f"train={len(tr_r)} test={len(te_u)} gen={time.perf_counter()-t0:.1f}s"
+    )
 
     n_dev = len(jax.devices())
     mesh = make_mesh(MeshConfig(axes={"data": n_dev})) if n_dev > 1 else None
     params = ALSParams(rank=10, reg=0.01, seed=3)
 
-    # Warmup: compile + one epoch (epoch time printed to stderr for tracking).
+    # Warmup: compile + one epoch (epoch cost tracked on stderr).
     t0 = time.perf_counter()
     train_als(
-        user_idx, item_idx, rating, num_users, num_items,
+        tr_u, tr_i, tr_r, num_users, num_items,
         params=ALSParams(rank=10, reg=0.01, seed=3, num_iterations=1),
         mesh=mesh,
     )
@@ -66,38 +322,54 @@ def main() -> None:
 
     t0 = time.perf_counter()
     state = train_als(
-        user_idx, item_idx, rating, num_users, num_items,
-        params=params, mesh=mesh,
+        tr_u, tr_i, tr_r, num_users, num_items, params=params, mesh=mesh
     )
     train_s = time.perf_counter() - t0
     assert np.isfinite(np.asarray(state.user_factors)).all()
+    log(f"# warmup(compile+1ep)={warm_s:.2f}s train(20 iter)={train_s:.2f}s")
 
-    import sys
-
-    # secondary: serving-path p50 (the /queries.json compute core — masked
-    # top-k over every item for one user) on the trained factors
-    import jax.numpy as jnp
-
-    from predictionio_tpu.models.recommendation.engine import _topk_for_user_idx
-
-    U = jnp.asarray(state.user_factors)
-    V = jnp.asarray(state.item_factors)
-    lat = []
-    _ = jax.block_until_ready(_topk_for_user_idx(U, V, jnp.int32(0), 10))
-    for q in range(200):
-        t0 = time.perf_counter()
-        jax.block_until_ready(
-            _topk_for_user_idx(U, V, jnp.int32(q % num_users), 10)
-        )
-        lat.append(time.perf_counter() - t0)
-    lat.sort()
-    p50_ms = lat[len(lat) // 2] * 1000
-
-    print(
-        f"# platform={platform} devices={n_dev} nnz={nnz} "
-        f"warmup(compile+1ep)={warm_s:.2f}s serving_topk_p50={p50_ms:.3f}ms",
-        file=sys.stderr,
+    # Quality probe: top-N ranking MAP@10.  Explicit rating-prediction ALS is
+    # a poor top-N ranker (well known); the ranking-quality number tracked by
+    # BASELINE uses the implicit-feedback variant on centered ratings
+    # (r - 3.5: low ratings become high-confidence negatives, the
+    # similarproduct LikeAlgorithm semantics), vs a popularity baseline for
+    # context.  Untimed — the timed headline above keeps reference hyperparams.
+    t0 = time.perf_counter()
+    imp = train_als(
+        tr_u, tr_i, tr_r - 3.5, num_users, num_items,
+        params=ALSParams(
+            rank=10, num_iterations=20, reg=0.01, seed=3,
+            implicit_prefs=True, alpha=2.0, chunk_size=1 << 18,
+        ),
+        mesh=mesh,
     )
+    imp_train_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    map10, prec10, n_eval = compute_ranking_metrics(
+        np.asarray(imp.user_factors), np.asarray(imp.item_factors),
+        tr_u, tr_i, te_u, te_i,
+    )
+    pop = np.bincount(tr_i, minlength=num_items).astype(np.float32)
+    map_pop, prec_pop, _ = compute_ranking_metrics(
+        np.ones((num_users, 1), np.float32),
+        pop[:, None],
+        tr_u, tr_i, te_u, te_i,
+        max_eval_users=4000,
+    )
+    log(
+        f"# MAP@10={map10:.4f} Precision@10={prec10:.4f} eval_users={n_eval} "
+        f"popularity-baseline MAP@10={map_pop:.4f} P@10={prec_pop:.4f} "
+        f"implicit_train={imp_train_s:.1f}s metrics={time.perf_counter()-t0:.1f}s"
+    )
+
+    model = build_als_model(state, num_users, num_items)
+    p50_single = serving_p50_single(model, num_users)
+    p50_conc = serving_p50_concurrent(model, num_users)
+    log(
+        f"# serving_p50={p50_single:.3f}ms "
+        f"serving_p50_concurrent32={p50_conc:.3f}ms (target <10ms)"
+    )
+
     print(
         json.dumps(
             {
@@ -107,6 +379,11 @@ def main() -> None:
                 "value": round(train_s, 3),
                 "unit": "s",
                 "vs_baseline": round(budget_s / train_s, 3),
+                "map_at_10": round(map10, 4),
+                "precision_at_10": round(prec10, 4),
+                "map_at_10_popularity_baseline": round(map_pop, 4),
+                "serving_p50_ms": round(p50_single, 3),
+                "serving_p50_concurrent32_ms": round(p50_conc, 3),
             }
         )
     )

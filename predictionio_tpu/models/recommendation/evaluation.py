@@ -41,6 +41,32 @@ class PrecisionAtK(OptionAverageMetric):
         return sum(1 for item in top if item in a) / min(self.k, len(a))
 
 
+class MAPAtK(OptionAverageMetric):
+    """Mean Average Precision @ k over users with relevant items.
+
+    AP@k = (1/min(k, |relevant|)) * sum_{r<=k, hit at r} precision@r — the
+    standard ranking metric the BASELINE tracks for ML-20M; None (skipped)
+    for users with no relevant items, like PrecisionAtK.
+    """
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    def header(self) -> str:
+        return f"MAP@{self.k}"
+
+    def calculate_one(self, q: Query, p: PredictedResult, a: frozenset):
+        if not a:
+            return None
+        hits = 0
+        ap = 0.0
+        for rank, s in enumerate(p.item_scores[: self.k], start=1):
+            if s.item in a:
+                hits += 1
+                ap += hits / rank
+        return ap / min(self.k, len(a))
+
+
 class PositiveCount(SumMetric):
     """Number of users with at least one relevant item (diagnostic)."""
 

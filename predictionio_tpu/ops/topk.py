@@ -1,0 +1,45 @@
+"""Host-side top-k for local-model serving paths.
+
+The reference's P2L algorithms serve single queries from a *local* model on
+the driver (controller/P2LAlgorithm.scala:46-76) — the TPU-native analog
+keeps a host numpy replica of small factor/score tables and answers solo
+queries without touching the device at all.  A [n_items] argpartition is
+~0.1 ms at ML-20M scale and, unlike a device dispatch, immune to device
+queue congestion; batched paths (eval, micro-batched serving waves) still go
+through the jit-compiled device kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def host_topk(scores: np.ndarray, k: int) -> tuple[np.ndarray, np.ndarray]:
+    """Top-k (values, indices) of a 1-D score vector, sorted descending."""
+    n = scores.shape[0]
+    k = min(k, n)
+    if k <= 0:
+        return scores[:0], np.zeros((0,), np.int64)
+    if k < n:
+        idx = np.argpartition(scores, n - k)[n - k:]
+    else:
+        idx = np.arange(n)
+    order = np.argsort(scores[idx])[::-1]
+    idx = idx[order]
+    return scores[idx], idx
+
+
+def host_topk_batch(scores: np.ndarray, k: int) -> tuple[np.ndarray, np.ndarray]:
+    """Row-wise top-k of a [B, n] score matrix, each row sorted descending."""
+    b, n = scores.shape
+    k = min(k, n)
+    if k <= 0:
+        return scores[:, :0], np.zeros((b, 0), np.int64)
+    if k < n:
+        idx = np.argpartition(scores, n - k, axis=1)[:, n - k:]
+    else:
+        idx = np.broadcast_to(np.arange(n), (b, n)).copy()
+    vals = np.take_along_axis(scores, idx, axis=1)
+    order = np.argsort(vals, axis=1)[:, ::-1]
+    idx = np.take_along_axis(idx, order, axis=1)
+    return np.take_along_axis(scores, idx, axis=1), idx

@@ -1,0 +1,258 @@
+"""Asyncio HTTP server front end for an :class:`HTTPApp`.
+
+The serving-latency-critical replacement for the thread-per-connection
+``AppServer`` (httpd.py): one event loop multiplexes every connection, async
+handlers can await the query :class:`MicroBatcher`, and sync handlers are
+pushed to the default executor so storage I/O never blocks the loop.  This is
+the akka-http role (workflow/CreateServer.scala:319-324) done the Python
+way — stdlib only, HTTP/1.1 with keep-alive.
+
+``HTTPApp`` routes registered with ``route`` work unchanged; handlers that
+are coroutine functions (``async def``) are awaited on the loop.  The same
+app object therefore serves under both the threaded server (tests, simple
+tools) and this one (deploy hot path).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+import os
+import ssl as ssl_mod
+import threading
+from typing import Any
+from urllib.parse import parse_qs, unquote, urlsplit
+
+from predictionio_tpu.server.httpd import (
+    HTTPApp,
+    Request,
+    Response,
+    error_response,
+)
+
+log = logging.getLogger("predictionio_tpu.aio")
+
+_MAX_HEADER_BYTES = 64 * 1024
+_MAX_BODY_BYTES = 64 * 1024 * 1024
+
+
+async def _handle_app_request(app: HTTPApp, req: Request) -> Response:
+    """Route like HTTPApp.handle, awaiting coroutine handlers and pushing
+    sync handlers to the executor."""
+    path_matched = False
+    for method, pattern, fn in app._routes:
+        m = pattern.match(req.path)
+        if not m:
+            continue
+        path_matched = True
+        if method != req.method:
+            continue
+        req.params = m.groupdict()
+        try:
+            if inspect.iscoroutinefunction(fn):
+                return await fn(req)
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(None, fn, req)
+        except Exception as e:
+            return error_response(500, f"{type(e).__name__}: {e}")
+    if path_matched:
+        return error_response(405, "Method Not Allowed")
+    return error_response(404, "Not Found")
+
+
+async def _read_request(reader: asyncio.StreamReader) -> Request | None:
+    """Parse one HTTP/1.1 request; None on clean EOF before a request."""
+    try:
+        head = await reader.readuntil(b"\r\n\r\n")
+    except asyncio.IncompleteReadError as e:
+        if not e.partial:
+            return None
+        raise
+    except asyncio.LimitOverrunError:
+        raise ValueError("request head too large")
+    if len(head) > _MAX_HEADER_BYTES:
+        raise ValueError("request head too large")
+    lines = head.decode("latin-1").split("\r\n")
+    method, target, _version = lines[0].split(" ", 2)
+    headers: dict[str, str] = {}
+    for line in lines[1:]:
+        if not line:
+            continue
+        k, _, v = line.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    length = int(headers.get("content-length") or 0)
+    if length > _MAX_BODY_BYTES:
+        raise ValueError("request body too large")
+    body = await reader.readexactly(length) if length else b""
+    if "?" in target:
+        split = urlsplit(target)
+        q = parse_qs(split.query, keep_blank_values=True)
+        path, query = split.path, {k: v[0] for k, v in q.items()}
+    else:  # hot path: no query string to parse
+        path, query = target, {}
+    if "%" in path:
+        path = unquote(path)
+    return Request(
+        method=method.upper(),
+        path=path,
+        query=query,
+        headers=headers,
+        body=body,
+    )
+
+
+def _encode_response(resp: Response, keep_alive: bool) -> bytes:
+    payload, ctype = resp.encoded()
+    lines = [
+        f"HTTP/1.1 {resp.status} {_reason(resp.status)}",
+        f"Content-Type: {ctype}",
+        f"Content-Length: {len(payload)}",
+        f"Connection: {'keep-alive' if keep_alive else 'close'}",
+    ]
+    lines += [f"{k}: {v}" for k, v in resp.headers.items()]
+    head = ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1")
+    return head + payload
+
+
+def _reason(status: int) -> str:
+    import http
+
+    try:
+        return http.HTTPStatus(status).phrase
+    except ValueError:
+        return "Unknown"
+
+
+class AsyncAppServer:
+    """Bind an HTTPApp on host:port under an asyncio event loop.
+
+    Mirrors the AppServer surface (start_background / serve_forever /
+    shutdown, .host/.port) so callers can swap front ends freely.  TLS comes
+    from the same PIO_SSL_CERTFILE/PIO_SSL_KEYFILE env vars.
+    """
+
+    def __init__(
+        self,
+        app: HTTPApp,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        ssl_certfile: str | None = None,
+        ssl_keyfile: str | None = None,
+    ):
+        self.app = app
+        self._req_host = host
+        self._req_port = port
+        certfile = ssl_certfile or os.environ.get("PIO_SSL_CERTFILE")
+        keyfile = ssl_keyfile or os.environ.get("PIO_SSL_KEYFILE")
+        self._ssl_ctx = None
+        if certfile:
+            self._ssl_ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_SERVER)
+            self._ssl_ctx.load_cert_chain(certfile, keyfile)
+        self.host: str = host
+        self.port: int = port
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._server: asyncio.Server | None = None
+        self._thread: threading.Thread | None = None
+        self._started = threading.Event()
+        self._stopped = threading.Event()
+
+    async def _client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                try:
+                    req = await _read_request(reader)
+                except (ValueError, asyncio.IncompleteReadError) as e:
+                    writer.write(
+                        _encode_response(
+                            error_response(400, f"bad request: {e}"), False
+                        )
+                    )
+                    await writer.drain()
+                    return
+                if req is None:
+                    return
+                resp = await _handle_app_request(self.app, req)
+                keep = req.headers.get("connection", "keep-alive") != "close"
+                writer.write(_encode_response(resp, keep))
+                await writer.drain()
+                if not keep:
+                    return
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _serve(self) -> None:
+        self._server = await asyncio.start_server(
+            self._client,
+            self._req_host,
+            self._req_port,
+            ssl=self._ssl_ctx,
+        )
+        sock = self._server.sockets[0].getsockname()
+        self.host, self.port = sock[0], sock[1]
+        self._started.set()
+        async with self._server:
+            try:
+                await self._server.serve_forever()
+            except asyncio.CancelledError:
+                pass
+
+    def _run_loop(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_until_complete(self._serve())
+        except asyncio.CancelledError:
+            pass
+        except BaseException as e:  # surface bind/TLS errors to the caller
+            self._startup_error = e
+            raise
+        finally:
+            self._started.set()  # unblock start_background on failure too
+            try:
+                self._loop.run_until_complete(self._loop.shutdown_asyncgens())
+            finally:
+                self._loop.close()
+                self._stopped.set()
+
+    def start_background(self) -> "AsyncAppServer":
+        self._startup_error: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._run_loop, name=f"{self.app.name}-aio", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("async server failed to start within 10s")
+        if self._startup_error is not None:
+            raise RuntimeError(
+                f"async server failed to start: {self._startup_error}"
+            ) from self._startup_error
+        return self
+
+    def serve_forever(self) -> None:
+        self._run_loop()
+
+    def shutdown(self) -> None:
+        loop, server = self._loop, self._server
+        if loop is None or server is None:
+            return
+
+        def _cancel_all():
+            for task in asyncio.all_tasks(loop):
+                task.cancel()
+
+        def _stop():
+            server.close()  # stop accepting; give in-flight responses
+            loop.call_later(0.3, _cancel_all)  # a beat to flush (/stop ack)
+
+        loop.call_soon_threadsafe(_stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        else:
+            self._stopped.wait(timeout=5)

@@ -147,6 +147,21 @@ class DeployedEngine:
         ]
         return query, serving.serve(query, predictions)
 
+    def predict_batch(self, queries: list[Any]) -> list[tuple[Any, Any]]:
+        """Serve a coalesced wave of queries in one vectorized
+        ``batch_predict`` pass per algorithm — the MicroBatcher target."""
+        with self._lock:
+            algorithms, models, serving = self.algorithms, self.models, self.serving
+        supplemented = [serving.supplement(q) for q in queries]
+        per_algo: list[list[Any]] = []
+        for a, m in zip(algorithms, models):
+            by_idx = dict(a.batch_predict(m, list(enumerate(supplemented))))
+            per_algo.append([by_idx[i] for i in range(len(supplemented))])
+        return [
+            (q, serving.serve(q, [col[i] for col in per_algo]))
+            for i, q in enumerate(supplemented)
+        ]
+
 
 # The engine-params JSON shape stored on EngineInstance rows round-trips
 # through params_from_json; reconstructing needs the name-keyed dicts.
@@ -176,6 +191,8 @@ def create_prediction_server_app(
     on_stop: Callable[[], None] | None = None,
     access_key: str | None = None,
     plugins: "PluginContext | None" = None,
+    use_microbatch: bool = False,
+    max_batch: int = 64,
 ) -> HTTPApp:
     from predictionio_tpu.server.plugins import PluginContext
 
@@ -244,25 +261,21 @@ def create_prediction_server_app(
             },
         )
 
-    @app.route("POST", "/queries\\.json")
-    def queries(req: Request) -> Response:
-        t0 = time.perf_counter()
-        # bad query JSON/shape -> 400; engine/server faults -> logged 500
-        # (the reference's MappingException / Throwable split,
-        # CreateServer.scala:607-630)
-        try:
-            payload = req.json()
-            if not isinstance(payload, dict):
-                raise ValueError("query must be a JSON object")
-            query = deployed.extract_query(payload)
-        except Exception as e:
-            return error_response(400, f"invalid query: {e}")
-        try:
-            query, prediction = deployed.predict(query)
-        except Exception as e:
-            log.exception("query serving failed")
-            return error_response(500, f"{type(e).__name__}: {e}")
-        rendered = _render_prediction(prediction)
+    # bad query JSON/shape -> 400; engine/server faults -> logged 500
+    # (the reference's MappingException / Throwable split,
+    # CreateServer.scala:607-630)
+    def _parse_query(req: Request):
+        payload = req.json()
+        if not isinstance(payload, dict):
+            raise ValueError("query must be a JSON object")
+        return payload, deployed.extract_query(payload)
+
+    def _finish_query(payload, query, prediction, t0: float) -> Response:
+        return _finish_rendered(
+            payload, query, _render_prediction(prediction), t0
+        )
+
+    def _finish_rendered(payload, query, rendered, t0: float) -> Response:
         rendered = plugins.process_output(
             deployed.instance.id, payload, rendered
         )
@@ -278,6 +291,109 @@ def create_prediction_server_app(
             stats["last_serving_sec"] = dt
             stats["request_count"] = n + 1
         return json_response(200, rendered)
+
+    if use_microbatch:
+        from predictionio_tpu.server.microbatch import MicroBatcher
+
+        def _postprocess(payload, query, prediction):
+            """Render + plugins + feedback — the blocking tail, on the
+            worker thread so the event loop stays free for I/O."""
+            rendered = plugins.process_output(
+                deployed.instance.id, payload, _render_prediction(prediction)
+            )
+            if feedback.enabled and feedback.app_id is not None:
+                try:
+                    _feedback_event(query, rendered)
+                except Exception as e:  # feedback must never fail the query
+                    log.error("feedback event failed: %s", e)
+            return rendered
+
+        def _serve_wave(payloads):
+            """Whole wave on the worker thread: extract + vectorized predict
+            + render/plugins/feedback.  Returns per item one of
+            ("ok", rendered) | ("bad", err) -> 400 | ("err", err) -> 500;
+            a poison query degrades only itself (per-item retry), never the
+            rest of the wave."""
+            parsed: list[tuple[str, Any]] = []
+            for pl in payloads:
+                try:
+                    parsed.append(("q", deployed.extract_query(pl)))
+                except Exception as e:
+                    parsed.append(("bad", e))
+            out: list[Any] = list(parsed)
+            ok_idx = [i for i, (tag, _) in enumerate(parsed) if tag == "q"]
+            if ok_idx:
+                try:
+                    results = deployed.predict_batch(
+                        [parsed[i][1] for i in ok_idx]
+                    )
+                    for i, (q, pred) in zip(ok_idx, results):
+                        out[i] = ("ok", _postprocess(payloads[i], q, pred))
+                except Exception:
+                    # fault isolation: retry each item solo
+                    log.exception(
+                        "wave predict failed; retrying queries individually"
+                    )
+                    for i in ok_idx:
+                        try:
+                            q, pred = deployed.predict(parsed[i][1])
+                            out[i] = ("ok", _postprocess(payloads[i], q, pred))
+                        except Exception as e:
+                            out[i] = ("err", e)
+            return out
+
+        batcher = MicroBatcher(_serve_wave, max_batch=max_batch)
+        app.microbatcher = batcher  # exposed for tests/status introspection
+
+        def _bump_stats(t0: float) -> None:
+            dt = time.perf_counter() - t0
+            with stats_lock:
+                n = stats["request_count"]
+                stats["avg_serving_sec"] = (
+                    stats["avg_serving_sec"] * n + dt
+                ) / (n + 1)
+                stats["last_serving_sec"] = dt
+                stats["request_count"] = n + 1
+
+        @app.route("POST", "/queries\\.json")
+        async def queries(req: Request) -> Response:
+            t0 = time.perf_counter()
+            try:
+                payload = req.json()
+                if not isinstance(payload, dict):
+                    raise ValueError("query must be a JSON object")
+            except Exception as e:
+                return error_response(400, f"invalid query: {e}")
+            try:
+                status, value = await batcher.submit(payload)
+            except Exception as e:
+                log.exception("query serving failed")
+                return error_response(500, f"{type(e).__name__}: {e}")
+            if status == "bad":
+                return error_response(400, f"invalid query: {value}")
+            if status == "err":
+                log.error("query serving failed: %s", value)
+                return error_response(
+                    500, f"{type(value).__name__}: {value}"
+                )
+            _bump_stats(t0)
+            return json_response(200, value)
+
+    else:
+
+        @app.route("POST", "/queries\\.json")
+        def queries(req: Request) -> Response:
+            t0 = time.perf_counter()
+            try:
+                payload, query = _parse_query(req)
+            except Exception as e:
+                return error_response(400, f"invalid query: {e}")
+            try:
+                query, prediction = deployed.predict(query)
+            except Exception as e:
+                log.exception("query serving failed")
+                return error_response(500, f"{type(e).__name__}: {e}")
+            return _finish_query(payload, query, prediction, t0)
 
     def _authorized(req: Request) -> bool:
         return access_key is None or req.query.get("accessKey") == access_key
@@ -393,7 +509,14 @@ def create_prediction_server(
     engine_variant: str = "default",
     feedback: FeedbackConfig | None = None,
     access_key: str | None = None,
-) -> AppServer:
+    server_kind: str = "aio",
+):
+    """Build the deploy server.
+
+    ``server_kind="aio"`` (default) serves under the asyncio front end with
+    query micro-batching — concurrent /queries.json requests coalesce into
+    one vectorized predict per wave.  ``"threaded"`` keeps the stdlib
+    thread-per-connection server (no batching)."""
     if port:
         if undeploy_stale(host, port, access_key):
             log.info("undeployed stale server on port %d", port)
@@ -405,15 +528,24 @@ def create_prediction_server(
         engine_version=engine_version,
         engine_variant=engine_variant,
     )
-    server_ref: list[AppServer] = []
+    server_ref: list[Any] = []
 
     def on_stop():
         if server_ref:
             server_ref[0].shutdown()
 
     app = create_prediction_server_app(
-        deployed, feedback=feedback, on_stop=on_stop, access_key=access_key
+        deployed,
+        feedback=feedback,
+        on_stop=on_stop,
+        access_key=access_key,
+        use_microbatch=server_kind == "aio",
     )
-    server = AppServer(app, host, port)
+    if server_kind == "aio":
+        from predictionio_tpu.server.aio import AsyncAppServer
+
+        server = AsyncAppServer(app, host, port)
+    else:
+        server = AppServer(app, host, port)
     server_ref.append(server)
     return server

@@ -10,6 +10,7 @@ detection, and round-trip back to JSON for the engine-instance registry.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import json
 import typing
 from typing import Any, Mapping, Type, TypeVar
@@ -40,12 +41,10 @@ def extract_params(cls: Type[T], payload: Mapping[str, Any] | None) -> T:
     payload = dict(payload or {})
     if not dataclasses.is_dataclass(cls):
         raise ParamsError(f"{cls!r} is not a dataclass params type")
-    aliases: Mapping[str, str] = getattr(cls, "params_aliases", {})
+    aliases, hints, fields, names = _class_info(cls)
     for json_name, field_name in aliases.items():
         if json_name in payload:
             payload[field_name] = payload.pop(json_name)
-    hints = typing.get_type_hints(cls)
-    names = {f.name for f in dataclasses.fields(cls)}
     unknown = set(payload) - names
     if unknown:
         raise ParamsError(
@@ -53,7 +52,7 @@ def extract_params(cls: Type[T], payload: Mapping[str, Any] | None) -> T:
             f"expected a subset of {sorted(names)}"
         )
     kwargs: dict[str, Any] = {}
-    for f in dataclasses.fields(cls):
+    for f in fields:
         if f.name in payload:
             kwargs[f.name] = _coerce(payload[f.name], hints.get(f.name), f.name)
         elif (
@@ -62,6 +61,19 @@ def extract_params(cls: Type[T], payload: Mapping[str, Any] | None) -> T:
         ):
             raise ParamsError(f"missing required param {f.name!r} for {cls.__name__}")
     return cls(**kwargs)  # type: ignore[return-value]
+
+
+@functools.lru_cache(maxsize=None)
+def _class_info(cls):
+    """Per-class introspection cache (type-hint resolution is ~40us; the
+    serving hot path extracts a Query per request)."""
+    fields = dataclasses.fields(cls)
+    return (
+        dict(getattr(cls, "params_aliases", {})),
+        typing.get_type_hints(cls),
+        fields,
+        frozenset(f.name for f in fields),
+    )
 
 
 def _coerce(value: Any, typ: Any, name: str) -> Any:

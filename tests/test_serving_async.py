@@ -1,0 +1,265 @@
+"""Serving-hardening tests: host top-k, MicroBatcher coalescing, the asyncio
+HTTP front end, and the micro-batched /queries.json path end to end."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.topk import host_topk, host_topk_batch
+from predictionio_tpu.server.aio import AsyncAppServer
+from predictionio_tpu.server.httpd import HTTPApp, Request, json_response
+from predictionio_tpu.server.microbatch import MicroBatcher
+
+
+class TestHostTopK:
+    def test_matches_argsort(self):
+        rng = np.random.default_rng(0)
+        s = rng.standard_normal(1000).astype(np.float32)
+        vals, idx = host_topk(s, 10)
+        expect = np.argsort(s)[::-1][:10]
+        np.testing.assert_array_equal(idx, expect)
+        np.testing.assert_array_equal(vals, s[expect])
+
+    def test_k_ge_n(self):
+        s = np.asarray([3.0, 1.0, 2.0], np.float32)
+        vals, idx = host_topk(s, 10)
+        np.testing.assert_array_equal(idx, [0, 2, 1])
+
+    def test_k_zero(self):
+        vals, idx = host_topk(np.ones(5, np.float32), 0)
+        assert len(vals) == 0 and len(idx) == 0
+
+    def test_batch_matches_single(self):
+        rng = np.random.default_rng(1)
+        s = rng.standard_normal((7, 300)).astype(np.float32)
+        vals, idx = host_topk_batch(s, 5)
+        for row in range(7):
+            v1, i1 = host_topk(s[row], 5)
+            np.testing.assert_array_equal(idx[row], i1)
+            np.testing.assert_array_equal(vals[row], v1)
+
+
+class TestMicroBatcher:
+    def test_coalesces_concurrent_submits(self):
+        waves: list[int] = []
+
+        def batch_fn(items):
+            waves.append(len(items))
+            time.sleep(0.02)  # hold the dispatch so others queue
+            return [i * 2 for i in items]
+
+        async def run():
+            b = MicroBatcher(batch_fn, max_batch=64)
+            results = await asyncio.gather(*(b.submit(i) for i in range(32)))
+            return b, results
+
+        b, results = asyncio.run(run())
+        assert results == [i * 2 for i in range(32)]
+        assert sum(waves) == 32
+        assert max(waves) > 1  # later waves coalesced while wave 1 slept
+
+    def test_max_batch_cap(self):
+        waves: list[int] = []
+
+        def batch_fn(items):
+            waves.append(len(items))
+            time.sleep(0.01)
+            return list(items)
+
+        async def run():
+            b = MicroBatcher(batch_fn, max_batch=4)
+            return await asyncio.gather(*(b.submit(i) for i in range(20)))
+
+        results = asyncio.run(run())
+        assert results == list(range(20))
+        assert max(waves) <= 4
+
+    def test_batch_fn_error_propagates(self):
+        def batch_fn(items):
+            raise RuntimeError("boom")
+
+        async def run():
+            b = MicroBatcher(batch_fn)
+            with pytest.raises(RuntimeError, match="boom"):
+                await b.submit(1)
+
+        asyncio.run(run())
+
+    def test_wrong_result_count_raises(self):
+        def batch_fn(items):
+            return list(items) + [99]  # always one extra
+
+        async def run():
+            b = MicroBatcher(batch_fn)
+            with pytest.raises(RuntimeError, match="results"):
+                await b.submit(1)
+
+        asyncio.run(run())
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read()
+
+
+def _post(url: str, payload: dict):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestAsyncAppServer:
+    @pytest.fixture()
+    def server(self):
+        app = HTTPApp("t")
+
+        @app.route("GET", "/ping")
+        def ping(req: Request):
+            return json_response(200, {"pong": True})
+
+        @app.route("POST", "/echo")
+        async def echo(req: Request):
+            await asyncio.sleep(0)
+            return json_response(200, req.json())
+
+        srv = AsyncAppServer(app, "127.0.0.1", 0).start_background()
+        yield srv
+        srv.shutdown()
+
+    def test_sync_and_async_handlers(self, server):
+        base = f"http://127.0.0.1:{server.port}"
+        status, body = _get(base + "/ping")
+        assert status == 200 and json.loads(body) == {"pong": True}
+        status, body = _post(base + "/echo", {"a": [1, 2]})
+        assert status == 200 and body == {"a": [1, 2]}
+
+    def test_404_and_405(self, server):
+        base = f"http://127.0.0.1:{server.port}"
+        for url, method, expect in [
+            (base + "/nope", "GET", 404),
+            (base + "/ping", "POST", 405),
+        ]:
+            req = urllib.request.Request(url, data=b"" if method == "POST" else None, method=method)
+            try:
+                urllib.request.urlopen(req, timeout=5)
+                raise AssertionError("expected HTTPError")
+            except urllib.error.HTTPError as e:
+                assert e.code == expect
+
+    def test_keep_alive_reuses_connection(self, server):
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
+        for _ in range(3):
+            conn.request("GET", "/ping")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            resp.read()
+        conn.close()
+
+    def test_concurrent_requests(self, server):
+        base = f"http://127.0.0.1:{server.port}"
+        with ThreadPoolExecutor(16) as ex:
+            results = list(ex.map(lambda _: _get(base + "/ping")[0], range(64)))
+        assert results == [200] * 64
+
+
+import urllib.error  # noqa: E402  (used in TestAsyncAppServer)
+
+
+class TestMicrobatchedQueries:
+    """End-to-end: deployed recommendation engine under the aio server with
+    micro-batching — concurrent queries coalesce yet all answer correctly."""
+
+    @pytest.fixture()
+    def deployed_server(self, storage):
+        from predictionio_tpu.core.base import EngineContext
+        from predictionio_tpu.core.engine import resolve_engine_factory
+        from predictionio_tpu.core.workflow import run_train
+        from predictionio_tpu.models import recommendation  # noqa: F401
+        from predictionio_tpu.server.prediction_server import (
+            create_prediction_server,
+        )
+        from predictionio_tpu.tools import commands as cmd
+
+        app_rec = cmd.app_new(storage, "mbq").app
+        rng = np.random.default_rng(0)
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.event import Event
+
+        levents = storage.l_events()
+        for n in range(300):
+            levents.insert(
+                Event(
+                    event="rate",
+                    entity_type="user",
+                    entity_id=f"u{n % 20}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{n % 30}",
+                    properties=DataMap({"rating": float(rng.integers(1, 6))}),
+                ),
+                app_rec.id,
+            )
+        engine = resolve_engine_factory("recommendation")()
+        params = engine.params_from_json(
+            {
+                "datasource": {
+                    "name": "ratings",
+                    "params": {"appName": "mbq"},
+                },
+                "algorithms": [
+                    {
+                        "name": "als",
+                        "params": {"rank": 4, "numIterations": 2},
+                    }
+                ],
+            }
+        )
+        ctx = EngineContext(storage=storage, mode="train")
+        run_train(
+            engine,
+            params,
+            ctx=ctx,
+            engine_factory="recommendation",
+            storage=storage,
+        )
+        server = create_prediction_server(
+            "recommendation",
+            host="127.0.0.1",
+            port=0,
+            storage=storage,
+            server_kind="aio",
+        ).start_background()
+        yield server
+        server.shutdown()
+
+    def test_concurrent_queries_coalesce(self, deployed_server):
+        base = f"http://127.0.0.1:{deployed_server.port}"
+        users = [f"u{i % 20}" for i in range(48)]
+        with ThreadPoolExecutor(16) as ex:
+            results = list(
+                ex.map(
+                    lambda u: _post(
+                        base + "/queries.json", {"user": u, "num": 3}
+                    ),
+                    users,
+                )
+            )
+        for status, body in results:
+            assert status == 200
+            assert len(body["itemScores"]) == 3
+        waves = deployed_server.app.microbatcher.wave_sizes
+        assert sum(k * v for k, v in waves.items()) == 48
