@@ -3,8 +3,10 @@
 Mirrors Storage.scala:158-223: sources from ``PIO_STORAGE_SOURCES_<NAME>_*``,
 repositories from ``PIO_STORAGE_REPOSITORIES_<REPO>_{NAME,SOURCE}``.
 Supported source TYPEs here: ``sqlite`` (events+metadata+models; the JDBC
-analog), ``localfs`` (models only).  With no configuration at all, everything
-lives under ``$PIO_HOME`` (default ``~/.predictionio_tpu``).
+analog), ``postgres`` (same, client-server), ``parquet`` (events only — the
+entity-hash-sharded columnar log, the ES/HBase role), ``localfs`` (models
+only).  With no configuration at all, everything lives under ``$PIO_HOME``
+(default ``~/.predictionio_tpu``).
 """
 
 from __future__ import annotations
@@ -133,6 +135,21 @@ class StorageRuntime:
         name, props = self.config.source_for("EVENTDATA")
         return self._sql_client(name, props)
 
+    def _parquet_client(self, name: str, props: dict[str, str]):
+        from predictionio_tpu.data.storage.parquet_backend import (
+            DEFAULT_N_SHARDS,
+            ParquetClient,
+        )
+
+        with self._lock:
+            key = f"__parquet_{name}__"
+            if key not in self._clients:
+                self._clients[key] = ParquetClient(
+                    props.get("PATH", str(self.config.home / "events_parquet")),
+                    n_shards=int(props.get("NSHARDS", DEFAULT_N_SHARDS)),
+                )
+            return self._clients[key]
+
     # -- metadata DAOs -------------------------------------------------------
     def apps(self) -> base.Apps:
         return SQLiteApps(self._meta_client())
@@ -163,15 +180,37 @@ class StorageRuntime:
     def l_events(self) -> base.LEvents:
         with self._lock:
             if "__levents__" not in self._clients:
-                self._clients["__levents__"] = SQLiteLEvents(self._event_client())
+                name, props = self.config.source_for("EVENTDATA")
+                if props.get("TYPE", "sqlite") == "parquet":
+                    from predictionio_tpu.data.storage.parquet_backend import (
+                        ParquetLEvents,
+                    )
+
+                    self._clients["__levents__"] = ParquetLEvents(
+                        self._parquet_client(name, props)
+                    )
+                else:
+                    self._clients["__levents__"] = SQLiteLEvents(
+                        self._event_client()
+                    )
             return self._clients["__levents__"]
 
     def p_events(self) -> base.PEvents:
         with self._lock:
             if "__pevents__" not in self._clients:
-                self._clients["__pevents__"] = SQLitePEvents(
-                    self._event_client(), self.l_events()
-                )
+                name, props = self.config.source_for("EVENTDATA")
+                if props.get("TYPE", "sqlite") == "parquet":
+                    from predictionio_tpu.data.storage.parquet_backend import (
+                        ParquetPEvents,
+                    )
+
+                    self._clients["__pevents__"] = ParquetPEvents(
+                        self._parquet_client(name, props)
+                    )
+                else:
+                    self._clients["__pevents__"] = SQLitePEvents(
+                        self._event_client(), self.l_events()
+                    )
             return self._clients["__pevents__"]
 
     # -- ops -----------------------------------------------------------------

@@ -1,0 +1,554 @@
+"""Partitioned columnar parquet event backend — the scalable event store.
+
+The reference's distributed event backends partition by an entity-hash row
+key: HBase prefixes each row with ``MD5(entityType-entityId)`` so entities
+spread uniformly and scans parallelize (storage/hbase/.../HBEventsUtil.scala:
+83-131); JDBC partitions bulk scans by time range (JDBCPEvents.scala:33-79);
+Elasticsearch shards server-side (ESLEvents.scala:41).  The TPU-native
+equivalent is an **append-only parquet event log sharded by entity hash**:
+
+    <root>/app_<appId>[_c<channelId>]/
+        _meta.json                   # {"n_shards": N}
+        shard=<k>/seg-<seq>.parquet  # row segments, append-only
+        _tombstones/del-<seq>.parquet# deleted event ids (app-global)
+
+Write model: every insert/write appends a new segment (no in-place update).
+Each row carries a monotonic ``seq``; scans dedup by ``event_id`` keeping
+the highest seq (so re-inserting an existing id upserts, LEvents contract)
+and drop ids whose latest op is a tombstone.  ``compact()`` folds segments +
+tombstones into one segment per shard.
+
+Read model: per-shard scans with pyarrow predicate pushdown.  ``LEvents``
+point lookups with an entity filter touch exactly one shard (the row-key
+benefit); ``ParquetPEvents.iter_shards`` yields one EventFrame per shard so
+bulk training scans never materialize the whole log, and multi-host workers
+can each take a shard range (SURVEY §7 step 9).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import shutil
+import threading
+import time
+from datetime import datetime, timezone
+from heapq import merge as heap_merge
+from pathlib import Path
+from typing import Iterator, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import (
+    EventFilter,
+    EventFrame,
+    LEvents,
+    PEvents,
+)
+
+DEFAULT_N_SHARDS = 16
+
+_SCHEMA = pa.schema(
+    [
+        ("event_id", pa.string()),
+        ("seq", pa.int64()),
+        ("event", pa.string()),
+        ("entity_type", pa.string()),
+        ("entity_id", pa.string()),
+        ("target_entity_type", pa.string()),
+        ("target_entity_id", pa.string()),
+        ("event_time_ms", pa.int64()),
+        ("creation_time_ms", pa.int64()),
+        ("properties", pa.string()),  # JSON
+        ("tags", pa.string()),  # JSON list
+        ("pr_id", pa.string()),
+    ]
+)
+
+_TOMB_SCHEMA = pa.schema([("event_id", pa.string()), ("seq", pa.int64())])
+
+
+def entity_shard(entity_type: str, entity_id: str, n_shards: int) -> int:
+    """The HBEventsUtil.scala:83 row-key hash, reduced to a shard index."""
+    digest = hashlib.md5(f"{entity_type}-{entity_id}".encode()).digest()
+    return int.from_bytes(digest[:4], "big") % n_shards
+
+
+def _to_ms(dt: datetime) -> int:
+    return int(dt.timestamp() * 1000)
+
+
+def _from_ms(ms: int) -> datetime:
+    return datetime.fromtimestamp(ms / 1000.0, tz=timezone.utc)
+
+
+class _SeqClock:
+    """Strictly-increasing int64: ns timestamp, bumped on collision."""
+
+    def __init__(self):
+        self._last = 0
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            now = time.time_ns()
+            self._last = max(self._last + 1, now)
+            return self._last
+
+
+class ParquetClient:
+    """Root-directory handle shared by the L/P DAO pair."""
+
+    def __init__(self, root: str | Path, n_shards: int = DEFAULT_N_SHARDS):
+        self.root = Path(root)
+        self.n_shards_default = n_shards
+        self.seq = _SeqClock()
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def app_dir(self, app_id: int, channel_id: int | None) -> Path:
+        name = f"app_{app_id}" + (f"_c{channel_id}" if channel_id else "")
+        return self.root / name
+
+    def n_shards(self, app_dir: Path) -> int:
+        meta = app_dir / "_meta.json"
+        if meta.exists():
+            return json.loads(meta.read_text())["n_shards"]
+        return self.n_shards_default
+
+    def init(self, app_id: int, channel_id: int | None) -> Path:
+        d = self.app_dir(app_id, channel_id)
+        d.mkdir(parents=True, exist_ok=True)
+        meta = d / "_meta.json"
+        if not meta.exists():
+            meta.write_text(json.dumps({"n_shards": self.n_shards_default}))
+        return d
+
+    def close(self) -> None:
+        pass
+
+
+def _event_row(e: Event, seq: int) -> dict:
+    return {
+        "event_id": e.event_id,
+        "seq": seq,
+        "event": e.event,
+        "entity_type": e.entity_type,
+        "entity_id": e.entity_id,
+        "target_entity_type": e.target_entity_type,
+        "target_entity_id": e.target_entity_id,
+        "event_time_ms": _to_ms(e.event_time),
+        "creation_time_ms": _to_ms(e.creation_time),
+        "properties": json.dumps(e.properties.fields) if e.properties.fields else "",
+        "tags": json.dumps(list(e.tags)) if e.tags else "",
+        "pr_id": e.pr_id,
+    }
+
+
+def _write_segment(shard_dir: Path, rows: list[dict], seq: int) -> None:
+    shard_dir.mkdir(parents=True, exist_ok=True)
+    table = pa.Table.from_pylist(rows, schema=_SCHEMA)
+    tmp = shard_dir / f".seg-{seq}.parquet.tmp"
+    pq.write_table(table, tmp, compression="zstd")
+    tmp.rename(shard_dir / f"seg-{seq}.parquet")
+
+
+def _filter_expression(f: EventFilter | None):
+    """Compile the EventFilter algebra to a pyarrow dataset predicate
+    (everything except limit/reversed, which apply post-sort)."""
+    if f is None:
+        return None
+    exprs = []
+    fld = pc.field
+    if f.start_time is not None:
+        exprs.append(fld("event_time_ms") >= _to_ms(f.start_time))
+    if f.until_time is not None:
+        exprs.append(fld("event_time_ms") < _to_ms(f.until_time))
+    if f.entity_type is not None:
+        exprs.append(fld("entity_type") == f.entity_type)
+    if f.entity_id is not None:
+        exprs.append(fld("entity_id") == f.entity_id)
+    if f.event_names is not None:
+        exprs.append(fld("event").isin(list(f.event_names)))
+    if f.target_entity_type is not None:
+        want = f.target_entity_type or None
+        exprs.append(
+            fld("target_entity_type") == want
+            if want is not None
+            else fld("target_entity_type").is_null()
+        )
+    if f.target_entity_id is not None:
+        want = f.target_entity_id or None
+        exprs.append(
+            fld("target_entity_id") == want
+            if want is not None
+            else fld("target_entity_id").is_null()
+        )
+    out = None
+    for e in exprs:
+        out = e if out is None else out & e
+    return out
+
+
+class ParquetEventStore:
+    """Shared scan/mutation engine for the L and P DAO facades."""
+
+    def __init__(self, client: ParquetClient):
+        self.client = client
+
+    # -- namespace lifecycle -------------------------------------------------
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        self.client.init(app_id, channel_id)
+        return True
+
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        d = self.client.app_dir(app_id, channel_id)
+        if d.exists():
+            shutil.rmtree(d)
+            return True
+        return False
+
+    # -- writes --------------------------------------------------------------
+    def append_events(
+        self, events: Sequence[Event], app_id: int, channel_id: int | None
+    ) -> list[str]:
+        d = self.client.init(app_id, channel_id)
+        n_shards = self.client.n_shards(d)
+        by_shard: dict[int, list[dict]] = {}
+        ids = []
+        seq = self.client.seq.next()
+        for e in events:
+            shard = entity_shard(e.entity_type, e.entity_id, n_shards)
+            by_shard.setdefault(shard, []).append(_event_row(e, seq))
+            ids.append(e.event_id)
+        for shard, rows in by_shard.items():
+            _write_segment(d / f"shard={shard}", rows, seq)
+        return ids
+
+    def append_tombstones(
+        self, event_ids: Sequence[str], app_id: int, channel_id: int | None
+    ) -> None:
+        d = self.client.init(app_id, channel_id)
+        tomb = d / "_tombstones"
+        tomb.mkdir(parents=True, exist_ok=True)
+        seq = self.client.seq.next()
+        table = pa.Table.from_pylist(
+            [{"event_id": i, "seq": seq} for i in event_ids],
+            schema=_TOMB_SCHEMA,
+        )
+        tmp = tomb / f".del-{seq}.parquet.tmp"
+        pq.write_table(table, tmp)
+        tmp.rename(tomb / f"del-{seq}.parquet")
+
+    # -- reads ---------------------------------------------------------------
+    def _tombstones(self, d: Path) -> dict[str, int]:
+        tomb = d / "_tombstones"
+        if not tomb.exists():
+            return {}
+        out: dict[str, int] = {}
+        for f in sorted(tomb.glob("del-*.parquet")):
+            t = pq.read_table(f)
+            for eid, seq in zip(
+                t.column("event_id").to_pylist(), t.column("seq").to_pylist()
+            ):
+                out[eid] = max(out.get(eid, 0), seq)
+        return out
+
+    def _shard_table(
+        self, shard_dir: Path, expr, tombs: dict[str, int]
+    ) -> pa.Table | None:
+        files = sorted(shard_dir.glob("seg-*.parquet"))
+        if not files:
+            return None
+        tables = []
+        for f in files:
+            t = pq.read_table(f)
+            if expr is not None:
+                t = t.filter(expr)
+            if t.num_rows:
+                tables.append(t)
+        if not tables:
+            return None
+        t = pa.concat_tables(tables)
+        # newest-wins dedup by event_id, then drop tombstoned rows
+        order = pc.sort_indices(
+            t, sort_keys=[("event_id", "ascending"), ("seq", "descending")]
+        )
+        t = t.take(order)
+        keep = np.ones(t.num_rows, dtype=bool)
+        ids = t.column("event_id").to_pylist()
+        seqs = t.column("seq").to_pylist()
+        prev = None
+        for i, eid in enumerate(ids):
+            if eid == prev:
+                keep[i] = False  # older duplicate
+            else:
+                prev = eid
+                tseq = tombs.get(eid)
+                if tseq is not None and tseq >= seqs[i]:
+                    keep[i] = False  # deleted
+        if not keep.all():
+            t = t.filter(pa.array(keep))
+        return t if t.num_rows else None
+
+    def shard_dirs(
+        self, app_id: int, channel_id: int | None
+    ) -> list[tuple[int, Path]]:
+        d = self.client.app_dir(app_id, channel_id)
+        if not d.exists():
+            return []
+        n = self.client.n_shards(d)
+        return [(k, d / f"shard={k}") for k in range(n)]
+
+    def scan_shards(
+        self,
+        app_id: int,
+        channel_id: int | None,
+        filter: EventFilter | None = None,
+        shards: Sequence[int] | None = None,
+    ) -> Iterator[tuple[int, pa.Table]]:
+        """Yield (shard index, deduped arrow table) per non-empty shard.
+
+        When the filter pins an entity, only its home shard is read."""
+        d = self.client.app_dir(app_id, channel_id)
+        if not d.exists():
+            return
+        n = self.client.n_shards(d)
+        expr = _filter_expression(filter)
+        tombs = self._tombstones(d)
+        if (
+            shards is None
+            and filter is not None
+            and filter.entity_type is not None
+            and filter.entity_id is not None
+        ):
+            shards = [entity_shard(filter.entity_type, filter.entity_id, n)]
+        for k, shard_dir in self.shard_dirs(app_id, channel_id):
+            if shards is not None and k not in shards:
+                continue
+            t = self._shard_table(shard_dir, expr, tombs)
+            if t is not None:
+                yield k, t
+
+    def get_by_id(
+        self, event_id: str, app_id: int, channel_id: int | None
+    ) -> pa.Table | None:
+        d = self.client.app_dir(app_id, channel_id)
+        if not d.exists():
+            return None
+        tombs = self._tombstones(d)
+        expr = pc.field("event_id") == event_id
+        for _, shard_dir in self.shard_dirs(app_id, channel_id):
+            t = self._shard_table(shard_dir, expr, tombs)
+            if t is not None:
+                return t
+        return None
+
+    # -- maintenance ---------------------------------------------------------
+    def compact(self, app_id: int, channel_id: int | None = None) -> int:
+        """Fold segments + tombstones into one segment per shard; returns the
+        number of live rows."""
+        d = self.client.app_dir(app_id, channel_id)
+        if not d.exists():
+            return 0
+        total = 0
+        tombs = self._tombstones(d)
+        seq = self.client.seq.next()
+        for k, shard_dir in self.shard_dirs(app_id, channel_id):
+            t = self._shard_table(shard_dir, None, tombs)
+            old = sorted(shard_dir.glob("seg-*.parquet"))
+            if t is not None:
+                tmp = shard_dir / f".seg-{seq}.parquet.tmp"
+                pq.write_table(t, tmp, compression="zstd")
+                tmp.rename(shard_dir / f"seg-{seq}.parquet")
+                total += t.num_rows
+            for f in old:
+                f.unlink()
+        tomb = d / "_tombstones"
+        if tomb.exists():
+            shutil.rmtree(tomb)
+        return total
+
+
+def _table_to_events(t: pa.Table) -> list[Event]:
+    cols = {name: t.column(name).to_pylist() for name in (
+        "event_id", "event", "entity_type", "entity_id",
+        "target_entity_type", "target_entity_id", "event_time_ms",
+        "creation_time_ms", "properties", "tags", "pr_id",
+    )}
+    out = []
+    for i in range(t.num_rows):
+        out.append(
+            Event(
+                event=cols["event"][i],
+                entity_type=cols["entity_type"][i],
+                entity_id=cols["entity_id"][i],
+                target_entity_type=cols["target_entity_type"][i],
+                target_entity_id=cols["target_entity_id"][i],
+                properties=DataMap(
+                    json.loads(cols["properties"][i])
+                    if cols["properties"][i]
+                    else {}
+                ),
+                event_time=_from_ms(cols["event_time_ms"][i]),
+                event_id=cols["event_id"][i],
+                tags=tuple(json.loads(cols["tags"][i])) if cols["tags"][i] else (),
+                pr_id=cols["pr_id"][i],
+                creation_time=_from_ms(cols["creation_time_ms"][i]),
+            )
+        )
+    return out
+
+
+def _table_to_frame(t: pa.Table) -> EventFrame:
+    def col(name) -> np.ndarray:
+        return np.asarray(t.column(name).to_pylist(), dtype=object)
+
+    props = np.empty(t.num_rows, dtype=object)
+    for i, s in enumerate(t.column("properties").to_pylist()):
+        props[i] = json.loads(s) if s else {}
+    tags = np.empty(t.num_rows, dtype=object)
+    for i, s in enumerate(t.column("tags").to_pylist()):
+        tags[i] = tuple(json.loads(s)) if s else ()
+    return EventFrame(
+        event=col("event"),
+        entity_type=col("entity_type"),
+        entity_id=col("entity_id"),
+        target_entity_type=col("target_entity_type"),
+        target_entity_id=col("target_entity_id"),
+        event_time_ms=np.asarray(t.column("event_time_ms").to_pylist(), np.int64),
+        properties=props,
+        event_id=col("event_id"),
+        tags=tags,
+        pr_id=col("pr_id"),
+        creation_time_ms=np.asarray(
+            t.column("creation_time_ms").to_pylist(), np.int64
+        ),
+    )
+
+
+def _sort_limit(t: pa.Table, filter: EventFilter | None) -> pa.Table:
+    direction = (
+        "descending" if (filter is not None and filter.reversed) else "ascending"
+    )
+    t = t.take(
+        pc.sort_indices(
+            t, sort_keys=[("event_time_ms", direction), ("seq", direction)]
+        )
+    )
+    if filter is not None and filter.limit is not None and filter.limit >= 0:
+        t = t.slice(0, filter.limit)
+    return t
+
+
+class ParquetLEvents(LEvents):
+    """Row-level DAO over the parquet log (the ESLEvents/HBLEvents role)."""
+
+    def __init__(self, client: ParquetClient):
+        self.store = ParquetEventStore(client)
+
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        return self.store.init(app_id, channel_id)
+
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        return self.store.remove(app_id, channel_id)
+
+    def close(self) -> None:
+        self.store.client.close()
+
+    def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
+        return self.store.append_events([event], app_id, channel_id)[0]
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: int | None = None
+    ) -> list[str]:
+        return self.store.append_events(events, app_id, channel_id)
+
+    def get(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> Event | None:
+        t = self.store.get_by_id(event_id, app_id, channel_id)
+        if t is None:
+            return None
+        return _table_to_events(t)[0]
+
+    def delete(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> bool:
+        if self.store.get_by_id(event_id, app_id, channel_id) is None:
+            return False
+        self.store.append_tombstones([event_id], app_id, channel_id)
+        return True
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        filter: EventFilter | None = None,
+    ) -> Iterator[Event]:
+        reverse = filter is not None and filter.reversed
+        limit = filter.limit if filter is not None else None
+
+        def shard_iter(t: pa.Table) -> Iterator[tuple]:
+            t = _sort_limit(t, filter)  # per-shard pre-limit is sound
+            for e in _table_to_events(t):
+                key = _to_ms(e.event_time)
+                yield (-key if reverse else key, e)
+
+        streams = [
+            shard_iter(t)
+            for _, t in self.store.scan_shards(app_id, channel_id, filter)
+        ]
+        count = 0
+        for _, e in heap_merge(*streams, key=lambda pair: pair[0]):
+            if limit is not None and 0 <= limit <= count:
+                return
+            count += 1
+            yield e
+
+
+class ParquetPEvents(PEvents):
+    """Bulk columnar DAO (the HBPEvents/JDBCPEvents role): per-shard
+    EventFrames for memory-bounded scans and multi-host shard ranges."""
+
+    def __init__(self, client: ParquetClient):
+        self.store = ParquetEventStore(client)
+
+    def iter_shards(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        filter: EventFilter | None = None,
+        shards: Sequence[int] | None = None,
+    ) -> Iterator[tuple[int, EventFrame]]:
+        for k, t in self.store.scan_shards(app_id, channel_id, filter, shards):
+            yield k, _table_to_frame(_sort_limit(t, None))
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        filter: EventFilter | None = None,
+    ) -> EventFrame:
+        tables = [
+            t for _, t in self.store.scan_shards(app_id, channel_id, filter)
+        ]
+        if not tables:
+            return EventFrame.from_events([])
+        t = _sort_limit(pa.concat_tables(tables), filter)
+        return _table_to_frame(t)
+
+    def write(
+        self, frame: EventFrame, app_id: int, channel_id: int | None = None
+    ) -> None:
+        self.store.append_events(frame.to_events(), app_id, channel_id)
+
+    def delete(
+        self, event_ids: Sequence[str], app_id: int, channel_id: int | None = None
+    ) -> None:
+        if event_ids:
+            self.store.append_tombstones(event_ids, app_id, channel_id)

@@ -1,4 +1,6 @@
-"""Storage SPI tests: sqlite events DAO, metadata DAOs, store facades."""
+"""Storage SPI tests: event DAOs (sqlite + parquet), metadata DAOs, store
+facades.  The module-level ``storage`` fixture overrides the conftest one to
+run every DAO test against BOTH event backends."""
 
 from datetime import datetime, timezone
 
@@ -14,6 +16,27 @@ from predictionio_tpu.data.storage.base import (
     EventFilter,
 )
 from predictionio_tpu.data.store import AppNotFoundError, LEventStore, PEventStore
+
+
+@pytest.fixture(params=["sqlite", "parquet"])
+def storage(request, tmp_path):
+    from predictionio_tpu.data.storage.config import (
+        StorageConfig,
+        reset_storage,
+    )
+
+    env = {"PIO_HOME": str(tmp_path / "pio_home")}
+    if request.param == "parquet":
+        env |= {
+            "PIO_STORAGE_SOURCES_PQ_TYPE": "parquet",
+            "PIO_STORAGE_SOURCES_PQ_PATH": str(tmp_path / "events_pq"),
+            "PIO_STORAGE_SOURCES_PQ_NSHARDS": "4",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "pio_event",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "PQ",
+        }
+    rt = reset_storage(StorageConfig.from_env(env))
+    yield rt
+    rt.close()
 
 
 def t(i):
