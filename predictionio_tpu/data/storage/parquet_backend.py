@@ -32,6 +32,7 @@ import json
 import shutil
 import threading
 import time
+import uuid
 from datetime import datetime, timezone
 from heapq import merge as heap_merge
 from pathlib import Path
@@ -111,7 +112,9 @@ class ParquetClient:
         self.root.mkdir(parents=True, exist_ok=True)
 
     def app_dir(self, app_id: int, channel_id: int | None) -> Path:
-        name = f"app_{app_id}" + (f"_c{channel_id}" if channel_id else "")
+        name = f"app_{app_id}" + (
+            f"_c{channel_id}" if channel_id is not None else ""
+        )
         return self.root / name
 
     def n_shards(self, app_dir: Path) -> int:
@@ -132,9 +135,9 @@ class ParquetClient:
         pass
 
 
-def _event_row(e: Event, seq: int) -> dict:
+def _event_row(e: Event, seq: int, event_id: str) -> dict:
     return {
-        "event_id": e.event_id,
+        "event_id": event_id,
         "seq": seq,
         "event": e.event,
         "entity_type": e.entity_type,
@@ -222,9 +225,14 @@ class ParquetEventStore:
         ids = []
         seq = self.client.seq.next()
         for e in events:
+            # Generate an id when the caller didn't supply one, mirroring
+            # SQLiteLEvents.insert and the per-event UUID baked into the
+            # HBase rowkey (HBEventsUtil.scala:83-131) — without it every
+            # anonymous insert would collide on a null id.
+            eid = e.event_id or uuid.uuid4().hex
             shard = entity_shard(e.entity_type, e.entity_id, n_shards)
-            by_shard.setdefault(shard, []).append(_event_row(e, seq))
-            ids.append(e.event_id)
+            by_shard.setdefault(shard, []).append(_event_row(e, seq, eid))
+            ids.append(eid)
         for shard, rows in by_shard.items():
             _write_segment(d / f"shard={shard}", rows, seq)
         return ids
@@ -264,17 +272,14 @@ class ParquetEventStore:
         files = sorted(shard_dir.glob("seg-*.parquet"))
         if not files:
             return None
-        tables = []
-        for f in files:
-            t = pq.read_table(f)
-            if expr is not None:
-                t = t.filter(expr)
-            if t.num_rows:
-                tables.append(t)
-        if not tables:
+        t = pa.concat_tables([pq.read_table(f) for f in files])
+        if not t.num_rows:
             return None
-        t = pa.concat_tables(tables)
-        # newest-wins dedup by event_id, then drop tombstoned rows
+        # Newest-wins dedup by event_id BEFORE the predicate: an upsert whose
+        # latest version no longer matches the filter must hide its superseded
+        # versions too (INSERT OR REPLACE semantics), so the winner per id is
+        # decided on unfiltered rows.  Null-id rows (legacy data) are always
+        # distinct — never collapsed against each other.
         order = pc.sort_indices(
             t, sort_keys=[("event_id", "ascending"), ("seq", "descending")]
         )
@@ -282,17 +287,19 @@ class ParquetEventStore:
         keep = np.ones(t.num_rows, dtype=bool)
         ids = t.column("event_id").to_pylist()
         seqs = t.column("seq").to_pylist()
-        prev = None
+        prev: object = object()  # unique sentinel; None must not match it
         for i, eid in enumerate(ids):
-            if eid == prev:
-                keep[i] = False  # older duplicate
+            if eid is not None and eid == prev:
+                keep[i] = False  # older duplicate of an upserted id
             else:
                 prev = eid
-                tseq = tombs.get(eid)
+                tseq = tombs.get(eid) if eid is not None else None
                 if tseq is not None and tseq >= seqs[i]:
                     keep[i] = False  # deleted
         if not keep.all():
             t = t.filter(pa.array(keep))
+        if expr is not None:
+            t = t.filter(expr)
         return t if t.num_rows else None
 
     def shard_dirs(

@@ -163,6 +163,76 @@ class TestPEvents:
                                             t(3).timestamp() * 1000]
 
 
+class TestParquetRegressions:
+    """Round-2 parquet bugs: null event ids, dedup-vs-filter order, channel 0."""
+
+    @pytest.fixture
+    def pq_store(self, tmp_path):
+        from predictionio_tpu.data.storage.parquet_backend import (
+            ParquetClient,
+            ParquetEventStore,
+            ParquetLEvents,
+        )
+
+        client = ParquetClient(tmp_path / "pq", n_shards=1)
+        return ParquetEventStore(client), ParquetLEvents(client)
+
+    def test_insert_without_id_generates_distinct_ids(self, pq_store):
+        store, le = pq_store
+        le.init(1)
+        # identical entity/time events with no caller-supplied id must stay
+        # distinct (the HBEventsUtil rowkey embeds a per-event UUID for this)
+        ids = le.insert_batch([mk("view", "u1", 1), mk("view", "u1", 1)], 1)
+        assert all(ids) and ids[0] != ids[1]
+        assert len(list(le.find(1))) == 2
+        assert le.get(ids[0], 1) is not None
+
+    def test_legacy_null_id_rows_not_collapsed(self, pq_store):
+        from predictionio_tpu.data.storage.parquet_backend import (
+            _event_row,
+            _write_segment,
+        )
+
+        store, le = pq_store
+        le.init(1)
+        # simulate legacy data: two distinct rows written with null ids into
+        # the same shard/segment — dedup must not collapse them
+        d = store.client.init(1, None)
+        rows = [
+            _event_row(mk("view", "u1", 1), 10, None),
+            _event_row(mk("buy", "u1", 2), 10, None),
+        ]
+        _write_segment(d / "shard=0", rows, 10)
+        assert sorted(e.event for e in le.find(1)) == ["buy", "view"]
+
+    def test_upsert_hides_superseded_version_from_filter(self, pq_store):
+        store, le = pq_store
+        le.init(1)
+        eid = le.insert(mk("view", "u1", 1), 1)
+        # upsert: same id, latest version no longer matches event=="view"
+        upd = Event(
+            event="buy",
+            entity_type="user",
+            entity_id="u1",
+            event_time=t(2),
+            event_id=eid,
+        )
+        le.insert(upd, 1)
+        # the superseded "view" row must not be resurrected by the filter
+        assert list(le.find(1, filter=EventFilter(event_names=("view",)))) == []
+        got = list(le.find(1, filter=EventFilter(event_names=("buy",))))
+        assert len(got) == 1 and got[0].event_id == eid
+
+    def test_channel_zero_distinct_from_default(self, pq_store):
+        store, le = pq_store
+        le.init(1)
+        le.init(1, 0)
+        le.insert(mk("view", "u1", 1), 1)
+        le.insert(mk("buy", "u2", 1), 1, 0)
+        assert [e.event for e in le.find(1)] == ["view"]
+        assert [e.event for e in le.find(1, 0)] == ["buy"]
+
+
 class TestMetadata:
     def test_apps(self, storage):
         apps = storage.apps()
