@@ -1,0 +1,21 @@
+# predictionio-tpu serving/training image (the reference's Dockerfile role).
+#
+# CPU by default; on a TPU VM swap the jax install for the libtpu wheel:
+#   pip install 'jax[tpu]' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+FROM python:3.12-slim
+
+WORKDIR /opt/predictionio-tpu
+COPY pyproject.toml README.md ./
+COPY predictionio_tpu ./predictionio_tpu
+COPY conf ./conf
+
+RUN pip install --no-cache-dir .
+
+ENV PIO_HOME=/var/lib/pio
+VOLUME ["/var/lib/pio"]
+
+# event server :7070, prediction server :8000, admin :7071, dashboard :9000
+EXPOSE 7070 8000 7071 9000
+
+ENTRYPOINT ["pio"]
+CMD ["status"]

@@ -267,12 +267,20 @@ class ParquetEventStore:
         return out
 
     def _shard_table(
-        self, shard_dir: Path, expr, tombs: dict[str, int]
+        self, shard_dir: Path, expr, tombs: dict[str, int], pre_filter=None
     ) -> pa.Table | None:
+        """Read a shard, newest-wins dedup, tombstone, then filter.
+
+        ``pre_filter`` is an optional predicate that is provably safe to
+        apply BEFORE dedup (it must select whole event_id groups, e.g. an
+        event_id equality) — point lookups use it so they never dedup the
+        full shard."""
         files = sorted(shard_dir.glob("seg-*.parquet"))
         if not files:
             return None
         t = pa.concat_tables([pq.read_table(f) for f in files])
+        if pre_filter is not None:
+            t = t.filter(pre_filter)
         if not t.num_rows:
             return None
         # Newest-wins dedup by event_id BEFORE the predicate: an upsert whose
@@ -348,9 +356,11 @@ class ParquetEventStore:
         if not d.exists():
             return None
         tombs = self._tombstones(d)
-        expr = pc.field("event_id") == event_id
+        # id equality selects a whole dedup group, so it can run before the
+        # dedup pass — point lookups stay O(matching rows), not O(shard).
+        pre = pc.field("event_id") == event_id
         for _, shard_dir in self.shard_dirs(app_id, channel_id):
-            t = self._shard_table(shard_dir, expr, tombs)
+            t = self._shard_table(shard_dir, None, tombs, pre_filter=pre)
             if t is not None:
                 return t
         return None

