@@ -2,21 +2,32 @@
 
 The reference Kryo-serializes the whole Seq[model] into the MODELDATA
 repository (workflow/CoreWorkflow.scala:76-81).  Here models are arbitrary
-Python objects whose array leaves may be jax device arrays: ``serialize``
-pulls every jax array to host numpy (device_get) and pickles; ``deserialize``
-restores numpy leaves (algorithms re-device_put / re-shard in
-``load_persistent_model``).  Checkpoint contents therefore never depend on
-device topology.
+Python objects whose array leaves may be jax device arrays: every jax array
+is pulled to host numpy (device_get) before pickling, so checkpoint contents
+never depend on device topology.
+
+Large array leaves (NCF embedding tables, ALS factor matrices) do not
+round-trip through one monolithic pickle: ``serialize_models_sharded`` spills
+every numpy leaf over ``PART_THRESHOLD`` bytes into its own named part
+(raw ``.npy`` bytes) via the pickle ``persistent_id`` hook, leaving a small
+manifest blob that references them.  Parts are stored as individual keyed
+blobs in any Models backend (localfs/sqlite/s3) — see
+``data/storage/base.Models.insert_parts`` — so a multi-gigabyte table is
+written and read leaf-by-leaf, and a deploy host streams parts instead of
+materializing blob + pickle + arrays three times over.
 """
 
 from __future__ import annotations
 
 import io
 import pickle
-from typing import Any
+from typing import Any, Callable
 
 import jax
 import numpy as np
+
+#: leaves at or above this many bytes become standalone parts
+PART_THRESHOLD = 1 << 20
 
 
 def _to_host(obj: Any) -> Any:
@@ -28,13 +39,61 @@ def _to_host(obj: Any) -> Any:
     )
 
 
-class _NumpyPickler(pickle.Pickler):
-    pass
+class _ShardingPickler(pickle.Pickler):
+    """Pickler that spills big ndarray leaves into a side table of parts.
+
+    ``persistent_id`` sees every object in the graph, registered pytree or
+    not — dataclasses, dicts, BiMaps — so any reachable large array is
+    sharded without cooperation from the containing type.
+    """
+
+    def __init__(self, buf: io.BytesIO, threshold: int):
+        super().__init__(buf, protocol=pickle.HIGHEST_PROTOCOL)
+        self.parts: dict[str, bytes] = {}
+        self.threshold = threshold
+        # persistent_id runs before pickle's own memoization, so aliased
+        # arrays (one table referenced from two fields) must be deduped here
+        # or they double both checkpoint size and deploy-host RAM
+        self._seen: dict[int, str] = {}
+        self._keepalive: list[Any] = []
+
+    def persistent_id(self, obj: Any):
+        if isinstance(obj, np.ndarray) and obj.nbytes >= self.threshold:
+            name = self._seen.get(id(obj))
+            if name is None:
+                name = f"leaf{len(self.parts):05d}"
+                part = io.BytesIO()
+                np.save(part, obj, allow_pickle=False)
+                self.parts[name] = part.getvalue()
+                self._seen[id(obj)] = name
+                self._keepalive.append(obj)  # pin id() for the dump's life
+            return ("pio-part", name)
+        return None
+
+
+class _ShardingUnpickler(pickle.Unpickler):
+    def __init__(self, buf: io.BytesIO, get_part: Callable[[str], bytes | None]):
+        super().__init__(buf)
+        self.get_part = get_part
+        self._loaded: dict[str, np.ndarray] = {}
+
+    def persistent_load(self, pid: Any) -> Any:
+        kind, name = pid
+        if kind != "pio-part":
+            raise pickle.UnpicklingError(f"unknown persistent id {pid!r}")
+        # memoized so aliased references restore as one shared array
+        if name not in self._loaded:
+            blob = self.get_part(name)
+            if blob is None:
+                raise pickle.UnpicklingError(f"missing model part {name!r}")
+            self._loaded[name] = np.load(io.BytesIO(blob), allow_pickle=False)
+        return self._loaded[name]
 
 
 def serialize_models(models: list[Any]) -> bytes:
+    """Single-blob format (legacy/small models)."""
     buf = io.BytesIO()
-    _NumpyPickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(
+    pickle.Pickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(
         [_to_host(m) for m in models]
     )
     return buf.getvalue()
@@ -42,3 +101,41 @@ def serialize_models(models: list[Any]) -> bytes:
 
 def deserialize_models(blob: bytes) -> list[Any]:
     return pickle.loads(blob)
+
+
+def serialize_models_sharded(
+    models: list[Any], threshold: int = PART_THRESHOLD
+) -> tuple[bytes, dict[str, bytes]]:
+    """Return (manifest blob, {part name: raw .npy bytes})."""
+    buf = io.BytesIO()
+    p = _ShardingPickler(buf, threshold)
+    p.dump([_to_host(m) for m in models])
+    return buf.getvalue(), p.parts
+
+
+def deserialize_models_sharded(
+    manifest: bytes, get_part: Callable[[str], bytes | None]
+) -> list[Any]:
+    """Inverse of ``serialize_models_sharded``; parts are fetched lazily
+    through ``get_part`` as the manifest references them."""
+    return _ShardingUnpickler(io.BytesIO(manifest), get_part).load()
+
+
+def save_models(models_store, instance_id: str, models: list[Any]) -> None:
+    """Persist a model list under an engine-instance id (sharded format)."""
+    manifest, parts = serialize_models_sharded(models)
+    models_store.insert_parts(instance_id, manifest, parts)
+
+
+def load_models(models_store, instance_id: str) -> list[Any] | None:
+    """Load a model list saved by ``save_models`` or the legacy single-blob
+    ``insert`` format (checked in that order)."""
+    manifest = models_store.get_manifest(instance_id)
+    if manifest is not None:
+        return deserialize_models_sharded(
+            manifest, lambda name: models_store.get_part(instance_id, name)
+        )
+    blob = models_store.get(instance_id)
+    if blob is None:
+        return None
+    return deserialize_models(blob)

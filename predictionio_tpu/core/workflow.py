@@ -21,7 +21,7 @@ from typing import Any, Callable, Sequence
 
 from predictionio_tpu.core.base import EngineContext
 from predictionio_tpu.core.engine import Engine, EngineParams
-from predictionio_tpu.core.persistence import serialize_models
+from predictionio_tpu.core.persistence import save_models
 from predictionio_tpu.data.storage.base import EngineInstance, EvaluationInstance
 from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
 
@@ -109,7 +109,9 @@ def run_train(
                 stored.append(PersistentModelManifest(type(m).class_path()))
             else:
                 stored.append(m)
-        storage.models().insert(instance.id, serialize_models(stored))
+        # sharded save: big array leaves (NCF tables, ALS factors) become
+        # individual parts instead of one monolithic pickle blob
+        save_models(storage.models(), instance.id, stored)
         done = instance.completed()
         instances.update(done)
         log.info("training finished: engine instance %s", instance.id)

@@ -13,7 +13,7 @@ from __future__ import annotations
 import abc
 from dataclasses import dataclass, field, replace
 from datetime import datetime, timezone
-from typing import Any, Iterable, Iterator, Sequence
+from typing import Any, Iterable, Iterator, Mapping, Sequence
 
 import numpy as np
 
@@ -210,7 +210,17 @@ class EvaluationInstances(abc.ABC):
 
 
 class Models(abc.ABC):
-    """Model blob store keyed by engine-instance id (Models.scala:33)."""
+    """Model blob store keyed by engine-instance id (Models.scala:33).
+
+    Besides the single-blob contract, every backend supports a *multipart*
+    checkpoint layout (manifest + named parts, used for sharded model saves
+    — the HDFS/S3 role of storing big models outside one row,
+    storage/s3/.../S3Models.scala:36).  The default implementation maps each
+    part onto an ordinary keyed blob (``<id>:part:<name>``) with the
+    manifest written last as the commit point, so any insert/get/delete
+    backend gets multipart for free; backends with a cheaper native layout
+    (e.g. one object per part on S3) may override.
+    """
 
     @abc.abstractmethod
     def insert(self, instance_id: str, blob: bytes) -> None: ...
@@ -220,6 +230,55 @@ class Models(abc.ABC):
 
     @abc.abstractmethod
     def delete(self, instance_id: str) -> bool: ...
+
+    # -- multipart (sharded checkpoints) -------------------------------------
+    def insert_parts(
+        self, instance_id: str, manifest: bytes, parts: Mapping[str, bytes]
+    ) -> None:
+        for name, blob in parts.items():
+            self.insert(f"{instance_id}:part:{name}", blob)
+        # manifest last: readers treat its presence as "all parts written"
+        self.insert(f"{instance_id}:manifest", _manifest_blob(manifest, parts))
+
+    def get_manifest(self, instance_id: str) -> bytes | None:
+        raw = self.get(f"{instance_id}:manifest")
+        return None if raw is None else _manifest_payload(raw)
+
+    def get_part(self, instance_id: str, name: str) -> bytes | None:
+        return self.get(f"{instance_id}:part:{name}")
+
+    def delete_parts(self, instance_id: str) -> bool:
+        raw = self.get(f"{instance_id}:manifest")
+        if raw is None:
+            return False
+        for name in _manifest_part_names(raw):
+            self.delete(f"{instance_id}:part:{name}")
+        return self.delete(f"{instance_id}:manifest")
+
+    def delete_models(self, instance_id: str) -> bool:
+        """Remove a checkpoint in either layout (sharded parts and/or the
+        legacy single blob) — the deletion entry point for cleanup paths."""
+        had_parts = self.delete_parts(instance_id)
+        had_blob = self.delete(instance_id)
+        return had_parts or had_blob
+
+
+def _manifest_blob(manifest: bytes, parts: Mapping[str, bytes]) -> bytes:
+    """Frame the part-name list in front of the manifest payload so
+    delete_parts can enumerate parts without deserializing models."""
+    names = ",".join(sorted(parts)).encode()
+    return len(names).to_bytes(4, "big") + names + manifest
+
+
+def _manifest_payload(raw: bytes) -> bytes:
+    n = int.from_bytes(raw[:4], "big")
+    return raw[4 + n:]
+
+
+def _manifest_part_names(raw: bytes) -> list[str]:
+    n = int.from_bytes(raw[:4], "big")
+    names = raw[4 : 4 + n].decode()
+    return names.split(",") if names else []
 
 
 # ---------------------------------------------------------------------------

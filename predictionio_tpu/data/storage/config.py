@@ -171,6 +171,15 @@ class StorageRuntime:
         typ = props.get("TYPE", "sqlite")
         if typ == "localfs":
             return LocalFSModels(props.get("PATH", str(self.config.home / "models")))
+        if typ == "s3":
+            from predictionio_tpu.data.storage.s3_models import S3Models
+
+            return S3Models(
+                bucket=props.get("BUCKET", ""),
+                prefix=props.get("PREFIX", ""),
+                region=props.get("REGION"),
+                endpoint=props.get("ENDPOINT"),
+            )
         if typ in ("sqlite", "postgres", "jdbc"):
             return SQLiteModels(self._sql_client(name, props))
         raise StorageError(f"unsupported MODELDATA source type {typ!r}")

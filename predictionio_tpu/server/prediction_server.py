@@ -32,7 +32,7 @@ from typing import Any, Callable
 
 from predictionio_tpu.core.base import EngineContext
 from predictionio_tpu.core.engine import Engine, resolve_engine_factory
-from predictionio_tpu.core.persistence import deserialize_models
+from predictionio_tpu.core.persistence import load_models
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage.base import EngineInstance
@@ -104,12 +104,11 @@ class DeployedEngine:
 
     def _bind(self, instance: EngineInstance) -> None:
         params = self.engine.params_from_json(_instance_variant(instance))
-        blob = self.storage.models().get(instance.id)
-        if blob is None:
+        persisted = load_models(self.storage.models(), instance.id)
+        if persisted is None:
             raise RuntimeError(
                 f"no model blob for engine instance {instance.id}"
             )
-        persisted = deserialize_models(blob)
         models = self.engine.prepare_deploy(
             self.ctx, params, persisted, instance_id=instance.id
         )

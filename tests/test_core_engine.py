@@ -6,7 +6,11 @@ import json
 import pytest
 
 from predictionio_tpu.core import Engine, EngineContext, EngineParams, SanityCheckError
-from predictionio_tpu.core.persistence import deserialize_models, serialize_models
+from predictionio_tpu.core.persistence import (
+    deserialize_models,
+    load_models,
+    serialize_models,
+)
 from predictionio_tpu.core.workflow import WorkflowParams, run_evaluation, run_train
 from predictionio_tpu.eval import FastEvalEngine, MetricEvaluator
 
@@ -125,7 +129,7 @@ class TestTrainWorkflow:
         stored = storage.engine_instances().get(inst.id)
         assert stored.status == "COMPLETED"
         assert json.loads(stored.preparator_params) == {"prep0": {"multiplier": 2}}
-        models = deserialize_models(storage.models().get(inst.id))
+        models = load_models(storage.models(), inst.id)
         assert models == [FakeModel(0, 2)]
 
     def test_run_train_failure_records_failed(self, ctx, storage):

@@ -1,0 +1,256 @@
+"""Sharded model checkpoints + object-store model repository.
+
+Covers the reference's remote model stores (storage/s3/.../S3Models.scala:36,
+storage/hdfs/.../HDFSModels.scala:31) and the per-leaf sharded save that
+keeps big embedding tables out of one monolithic pickle blob.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from dataclasses import dataclass
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.persistence import (
+    PART_THRESHOLD,
+    deserialize_models_sharded,
+    load_models,
+    save_models,
+    serialize_models_sharded,
+)
+from predictionio_tpu.data.storage.localfs_models import LocalFSModels
+from predictionio_tpu.data.storage.s3_models import S3Models
+
+
+@dataclass
+class NCFLikeModel:
+    """Stand-in for a sharded-table model: two big tables + small metadata."""
+
+    user_table: np.ndarray
+    item_table: np.ndarray
+    vocab: dict
+
+
+def make_model(rows=70_000) -> NCFLikeModel:
+    rng = np.random.default_rng(0)
+    return NCFLikeModel(
+        user_table=rng.standard_normal((rows, 8)).astype(np.float32),
+        item_table=rng.standard_normal((rows // 2, 8)).astype(np.float32),
+        vocab={"u0": 0, "i0": 0},
+    )
+
+
+class TestShardedSerialization:
+    def test_big_leaves_become_parts(self):
+        m = make_model()
+        manifest, parts = serialize_models_sharded([m])
+        # both tables exceed the threshold -> exactly two parts
+        assert len(parts) == 2
+        assert all(len(b) >= PART_THRESHOLD for b in parts.values())
+        # the manifest must NOT embed the table bytes
+        assert len(manifest) < PART_THRESHOLD
+
+    def test_round_trip(self):
+        m = make_model()
+        manifest, parts = serialize_models_sharded([m])
+        [out] = deserialize_models_sharded(manifest, parts.get)
+        np.testing.assert_array_equal(out.user_table, m.user_table)
+        np.testing.assert_array_equal(out.item_table, m.item_table)
+        assert out.vocab == m.vocab
+
+    def test_small_models_have_no_parts(self):
+        manifest, parts = serialize_models_sharded([{"w": np.arange(4.0)}])
+        assert parts == {}
+        [out] = deserialize_models_sharded(manifest, parts.get)
+        np.testing.assert_array_equal(out["w"], np.arange(4.0))
+
+    def test_missing_part_raises(self):
+        manifest, parts = serialize_models_sharded([make_model()])
+        with pytest.raises(Exception, match="missing model part"):
+            deserialize_models_sharded(manifest, lambda name: None)
+
+    def test_aliased_table_stored_once(self):
+        """One table referenced from two fields must produce one part and
+        restore as one (shared) array."""
+        table = np.random.default_rng(0).standard_normal((70_000, 8)).astype(
+            np.float32
+        )
+        manifest, parts = serialize_models_sharded([{"x": table, "y": table}])
+        assert len(parts) == 1
+        [out] = deserialize_models_sharded(manifest, parts.get)
+        assert out["x"] is out["y"]
+        np.testing.assert_array_equal(out["x"], table)
+
+
+class TestMultipartStore:
+    def test_localfs_parts_are_separate_files(self, tmp_path):
+        store = LocalFSModels(tmp_path)
+        m = make_model()
+        save_models(store, "inst1", [m])
+        files = list(tmp_path.glob("pio_model_inst1*"))
+        assert len(files) == 3  # manifest + 2 parts
+        [out] = load_models(store, "inst1")
+        np.testing.assert_array_equal(out.user_table, m.user_table)
+
+    def test_legacy_single_blob_still_loads(self, tmp_path):
+        from predictionio_tpu.core.persistence import serialize_models
+
+        store = LocalFSModels(tmp_path)
+        store.insert("legacy", serialize_models([{"w": np.arange(3.0)}]))
+        [out] = load_models(store, "legacy")
+        np.testing.assert_array_equal(out["w"], np.arange(3.0))
+
+    def test_delete_models_removes_both_layouts(self, tmp_path):
+        store = LocalFSModels(tmp_path)
+        save_models(store, "inst1", [make_model()])
+        store.insert("inst2", b"legacy-blob")
+        assert store.delete_models("inst1")
+        assert store.delete_models("inst2")
+        assert list(tmp_path.glob("pio_model_inst*")) == []
+        assert load_models(store, "inst1") is None
+        assert not store.delete_models("inst1")  # already gone
+
+
+class FakeS3Client:
+    """dict-backed boto3-shaped client (put/get/delete_object)."""
+
+    class exceptions:
+        class NoSuchKey(Exception):
+            pass
+
+    def __init__(self):
+        self.objects: dict[str, bytes] = {}
+
+    def put_object(self, Bucket, Key, Body):
+        self.objects[f"{Bucket}/{Key}"] = bytes(Body)
+
+    def get_object(self, Bucket, Key):
+        k = f"{Bucket}/{Key}"
+        if k not in self.objects:
+            raise self.exceptions.NoSuchKey(k)
+        return {"Body": self.objects[k]}
+
+    def delete_object(self, Bucket, Key):
+        self.objects.pop(f"{Bucket}/{Key}", None)
+
+
+class TestS3Models:
+    def test_round_trip(self):
+        client = FakeS3Client()
+        store = S3Models("models", prefix="pio/", client=client)
+        store.insert("i1", b"blob")
+        assert store.get("i1") == b"blob"
+        assert "models/pio/pio_model_i1" in client.objects
+        assert store.delete("i1") is True
+        assert store.get("i1") is None
+        assert store.delete("i1") is False
+
+    def test_sharded_save_uses_one_object_per_part(self):
+        client = FakeS3Client()
+        store = S3Models("models", client=client)
+        m = make_model()
+        save_models(store, "inst1", [m])
+        assert len(client.objects) == 3  # manifest + 2 parts
+        [out] = load_models(store, "inst1")
+        np.testing.assert_array_equal(out.item_table, m.item_table)
+
+    def test_missing_boto3_is_actionable(self):
+        with pytest.raises((ImportError, Exception), match="boto3"):
+            S3Models("bucket")  # no client injected, boto3 not installed
+
+    def test_requires_bucket(self):
+        with pytest.raises(ValueError, match="BUCKET"):
+            S3Models("", client=FakeS3Client())
+
+
+_TRAIN_SCRIPT = r"""
+import sys
+from predictionio_tpu.core.base import EngineContext
+from predictionio_tpu.core.workflow import run_train
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.config import get_storage
+from predictionio_tpu.models.recommendation.engine import recommendation_engine
+
+from predictionio_tpu.data.storage.base import App
+
+storage = get_storage()
+app_id = storage.apps().insert(App(id=0, name="xproc"))
+le = storage.l_events()
+le.init(app_id)
+import datetime as dt
+t0 = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+events = []
+for u in range(30):
+    for i in range(20):
+        if (u + i) % 3 == 0:
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties={"rating": float((u * i) % 5 + 1)}, event_time=t0))
+le.insert_batch(events, app_id)
+engine = recommendation_engine()
+params = engine.params_from_json({
+    "datasource": {"params": {"appName": "xproc"}},
+    "algorithms": [{"name": "als", "params": {
+        "rank": 4, "numIterations": 3}}],
+})
+inst = run_train(engine, params, ctx=EngineContext(storage=storage),
+                 storage=storage, engine_factory="recommendation")
+print(inst.id)
+"""
+
+_SERVE_SCRIPT = r"""
+import sys
+from predictionio_tpu.core.base import EngineContext
+from predictionio_tpu.data.storage.config import get_storage
+from predictionio_tpu.models.recommendation.engine import (
+    ALSAlgorithm, Query, recommendation_engine,
+)
+from predictionio_tpu.core.persistence import load_models
+
+storage = get_storage()
+inst = storage.engine_instances().get(sys.argv[1])
+assert inst is not None and inst.status == "COMPLETED", inst
+engine = recommendation_engine()
+params = engine.params_from_json({
+    "datasource": {"params": {"appName": "xproc"}},
+    "algorithms": [{"name": "als", "params": {
+        "rank": 4, "numIterations": 3}}],
+})
+persisted = load_models(storage.models(), sys.argv[1])
+[model] = engine.prepare_deploy(
+    EngineContext(storage=storage, mode="serving"), params, persisted,
+    instance_id=sys.argv[1])
+r = ALSAlgorithm(params.algorithms[0][1]).predict(model, Query(user="u1", num=3))
+assert len(r.item_scores) == 3, r
+print("OK", r.item_scores[0].item)
+"""
+
+
+class TestCrossProcessDeploy:
+    def test_train_then_deploy_in_separate_processes(self, tmp_path):
+        """Train in one OS process, deploy + predict from a second one that
+        shares only the store path (the train-here/serve-there contract the
+        remote model stores exist for)."""
+        env = dict(
+            os.environ,
+            PIO_HOME=str(tmp_path / "home"),
+            JAX_PLATFORMS="cpu",
+        )
+        train = subprocess.run(
+            [sys.executable, "-c", _TRAIN_SCRIPT],
+            capture_output=True, text=True, env=env, timeout=300,
+        )
+        assert train.returncode == 0, train.stderr[-2000:]
+        instance_id = train.stdout.strip().splitlines()[-1]
+        serve = subprocess.run(
+            [sys.executable, "-c", _SERVE_SCRIPT, instance_id],
+            capture_output=True, text=True, env=env, timeout=300,
+        )
+        assert serve.returncode == 0, serve.stderr[-2000:]
+        assert serve.stdout.startswith("OK"), serve.stdout

@@ -94,7 +94,7 @@ class TestPersistentModel:
         import predictionio_tpu.core.persistent_model as pm
         from predictionio_tpu.core.base import EngineContext
         from predictionio_tpu.core.engine import SimpleEngine
-        from predictionio_tpu.core.persistence import deserialize_models
+        from predictionio_tpu.core.persistence import load_models
         from predictionio_tpu.core.workflow import run_train
 
         tests_mod_model = SelfSavingModel
@@ -118,8 +118,7 @@ class TestPersistentModel:
         instance = run_train(
             engine, params, ctx=EngineContext(storage=storage), storage=storage
         )
-        blob = storage.models().get(instance.id)
-        (stored,) = deserialize_models(blob)
+        (stored,) = load_models(storage.models(), instance.id)
         assert isinstance(stored, pm.PersistentModelManifest)
         models = engine.prepare_deploy(
             EngineContext(storage=storage), params, [stored],

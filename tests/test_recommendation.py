@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from predictionio_tpu.core import EngineContext, EngineParams
-from predictionio_tpu.core.persistence import deserialize_models
+from predictionio_tpu.core.persistence import load_models
 from predictionio_tpu.core.workflow import run_evaluation, run_train
 from predictionio_tpu.data import DataMap, Event
 from predictionio_tpu.data.storage.base import App
@@ -84,7 +84,7 @@ class TestQuickstart:
         assert inst.status == "COMPLETED"
 
         # reload as deploy does, then query
-        persisted = deserialize_models(storage.models().get(inst.id))
+        persisted = load_models(storage.models(), inst.id)
         ep = make_params()
         [model] = engine.prepare_deploy(ctx, ep, persisted)
         algo = ALSAlgorithm(ep.algorithms[0][1])
