@@ -47,26 +47,67 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def make_movielens_like(nnz: int, num_users: int, num_items: int, seed: int = 3):
-    """Deterministic ML-shaped ratings (COO): Zipf item exposure, lognormal
-    user activity, item quality correlated with popularity (as in real
-    MovieLens), planted rank-8 personal preference structure + noise."""
+def make_movielens_like(
+    nnz: int,
+    num_users: int,
+    num_items: int,
+    seed: int = 3,
+    browse_k: int = 8,
+    browse_frac: float = 0.7,
+):
+    """Deterministic ML-shaped ratings (COO): Zipf item popularity, lognormal
+    user activity, item quality correlated with popularity, planted rank-8
+    personal preference structure + noise.
+
+    Exposure is preference-correlated the way real watch data is: for
+    ``browse_frac`` of interactions the user "browses" ``browse_k``
+    popularity-drawn candidates and watches the one they prefer most
+    (best-of-K choice); the rest are pure popularity impressions.  Marginal
+    item popularity stays Zipf-anchored (candidates are always drawn from
+    the Zipf), so popularity is still a strong baseline — but which popular
+    item a user watches, and rates highly, carries their planted taste.
+    """
     rng = np.random.default_rng(seed)
     item_p = (np.arange(num_items) + 10.0) ** -0.8
     item_p /= item_p.sum()
+    item_cdf = np.cumsum(item_p)
     user_w = rng.lognormal(0.0, 1.0, num_users)
     user_p = user_w / user_w.sum()
-    user_idx = rng.choice(num_users, nnz, p=user_p).astype(np.int64)
-    item_idx = rng.choice(num_items, nnz, p=item_p).astype(np.int64)
+    user_cdf = np.cumsum(user_p)
+    # inverse-CDF sampling: ~10x faster than rng.choice(p=...) at this scale
+    user_idx = np.searchsorted(user_cdf, rng.random(nnz)).astype(np.int64)
+    user_idx = np.minimum(user_idx, num_users - 1)
     uf = rng.standard_normal((num_users, RANK_PLANTED)).astype(np.float32)
     vf = rng.standard_normal((num_items, RANK_PLANTED)).astype(np.float32)
+
+    item_idx = np.empty(nnz, np.int64)
+    browse = rng.random(nnz) < browse_frac
+    n_plain = int((~browse).sum())
+    plain = np.searchsorted(item_cdf, rng.random(n_plain)).astype(np.int64)
+    item_idx[~browse] = np.minimum(plain, num_items - 1)
+    b_users = user_idx[browse]
+    browse_pos = np.flatnonzero(browse)
+    # chunked best-of-K: candidates by popularity, winner by planted taste
+    for c0 in range(0, len(b_users), 2_000_000):
+        bu = b_users[c0 : c0 + 2_000_000]
+        cand = np.searchsorted(
+            item_cdf, rng.random((len(bu), browse_k))
+        ).astype(np.int64)
+        cand = np.minimum(cand, num_items - 1)
+        pref = np.einsum("nk,njk->nj", uf[bu], vf[cand])
+        pick = cand[np.arange(len(bu)), pref.argmax(1)]
+        item_idx[browse_pos[c0 : c0 + 2_000_000]] = pick
+
     zpop = -np.log(np.arange(num_items) + 10.0)
     zpop = (zpop - zpop.mean()) / zpop.std()
     item_bias = (
         0.3 * zpop + 0.2 * rng.standard_normal(num_items)
     ).astype(np.float32)
+    # base 1.55: best-of-K selection raises the mean planted preference of
+    # *watched* items by ~+1.3 stars, so the observed rating distribution
+    # recenters near the ML-20M shape (mean ~3.4, ~40% of ratings >= 4)
     raw = (
-        3.1
+        1.55
         + item_bias[item_idx]
         + 1.8
         * np.einsum("nk,nk->n", uf[user_idx], vf[item_idx])
@@ -165,6 +206,37 @@ def build_als_model(state, num_users, num_items):
         user_vocab=user_vocab,
         item_vocab=item_vocab,
     )
+
+
+def ncf_serving_p50(ncf_state, num_users, num_items, n=200):
+    """NCF-template serving path: vocab lookup + on-device score_all_items
+    top-k through NCFAlgorithm.predict."""
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.models.ncf.engine import (
+        NCFAlgorithm,
+        NCFModel,
+        Query,
+    )
+
+    model = NCFModel(
+        state=ncf_state,
+        user_vocab=BiMap.from_keys(
+            np.asarray([str(u) for u in range(num_users)])
+        ),
+        item_vocab=BiMap.from_keys(
+            np.asarray([str(i) for i in range(num_items)])
+        ),
+    )
+    algo = NCFAlgorithm()
+    algo.predict(model, Query(user="0", num=K))  # compile
+    lat = []
+    for q in range(n):
+        t0 = time.perf_counter()
+        r = algo.predict(model, Query(user=str(q % num_users), num=K))
+        lat.append(time.perf_counter() - t0)
+        assert r.item_scores
+    lat.sort()
+    return lat[len(lat) // 2] * 1000
 
 
 def serving_p50_single(model, num_users, n=500):
@@ -276,8 +348,7 @@ def serving_p50_concurrent(model, num_users, clients=32, per_client=40):
             p99s.append(r["p99_ms"])
         sizes = sorted(app.microbatcher.wave_sizes.items())
         log(f"# microbatch waves (size: count): {sizes}")
-        log(f"# concurrent p99={max(p99s):.3f}ms")
-        return sum(p50s) / len(p50s)
+        return sum(p50s) / len(p50s), max(p99s)
     finally:
         server.shutdown()
 
@@ -328,15 +399,37 @@ def main() -> None:
     assert np.isfinite(np.asarray(state.user_factors)).all()
     log(f"# warmup(compile+1ep)={warm_s:.2f}s train(20 iter)={train_s:.2f}s")
 
+    # Distribution-robustness probe: the same kernel on uniformly-sampled
+    # data of identical shape (compile cache hit).  The flat-row scatter
+    # layout makes the epoch time insensitive to index skew; this line
+    # proves it on every run.
+    rng_u = np.random.default_rng(5)
+    uu = rng_u.integers(0, num_users, len(tr_u)).astype(np.int64)
+    ui = rng_u.integers(0, num_items, len(tr_u)).astype(np.int64)
+    t0 = time.perf_counter()
+    train_als(
+        uu, ui, tr_r, num_users, num_items,
+        params=ALSParams(rank=10, reg=0.01, seed=3, num_iterations=2),
+        mesh=mesh,
+    )
+    ep_uniform = (time.perf_counter() - t0) / 2
+    log(
+        f"# epoch_time skewed={train_s / params.num_iterations:.2f}s "
+        f"uniform={ep_uniform:.2f}s (distribution-robustness)"
+    )
+
     # Quality probe: top-N ranking MAP@10.  Explicit rating-prediction ALS is
     # a poor top-N ranker (well known); the ranking-quality number tracked by
-    # BASELINE uses the implicit-feedback variant on centered ratings
-    # (r - 3.5: low ratings become high-confidence negatives, the
-    # similarproduct LikeAlgorithm semantics), vs a popularity baseline for
-    # context.  Untimed — the timed headline above keeps reference hyperparams.
+    # BASELINE uses implicit-feedback ALS on binary positives (rating >= 4,
+    # the reference templates' train-with-rate-event thresholding), vs a
+    # popularity baseline for context.  Untimed — the timed headline above
+    # keeps reference hyperparams.
     t0 = time.perf_counter()
+    pos_mask = tr_r >= 4.0
     imp = train_als(
-        tr_u, tr_i, tr_r - 3.5, num_users, num_items,
+        tr_u[pos_mask], tr_i[pos_mask],
+        np.ones(int(pos_mask.sum()), np.float32),
+        num_users, num_items,
         params=ALSParams(
             rank=10, num_iterations=20, reg=0.01, seed=3,
             implicit_prefs=True, alpha=2.0, chunk_size=1 << 18,
@@ -362,12 +455,40 @@ def main() -> None:
         f"implicit_train={imp_train_s:.1f}s metrics={time.perf_counter()-t0:.1f}s"
     )
 
+    # NCF flagship: epochs/s on the on-device pipeline (one XLA dispatch per
+    # epoch: device-side shuffle + in-step negative sampling + lax.scan) and
+    # serving p50 through the NCF template's predict path.
+    from predictionio_tpu.ops.ncf import NCFParams, train_ncf
+
+    ncf_u = tr_u[pos_mask].astype(np.int32)
+    ncf_i = tr_i[pos_mask].astype(np.int32)
+    t0 = time.perf_counter()
+    train_ncf(ncf_u, ncf_i, num_users, num_items,
+              params=NCFParams(embed_dim=32, batch_size=8192, seed=3,
+                               num_epochs=1), mesh=mesh)
+    ncf_warm_s = time.perf_counter() - t0
+    ncf_epochs = 3
+    t0 = time.perf_counter()
+    ncf_state = train_ncf(
+        ncf_u, ncf_i, num_users, num_items,
+        params=NCFParams(embed_dim=32, batch_size=8192, seed=3,
+                         num_epochs=ncf_epochs), mesh=mesh)
+    ncf_eps = ncf_epochs / (time.perf_counter() - t0)
+    log(
+        f"# ncf warmup={ncf_warm_s:.1f}s epochs_per_s={ncf_eps:.3f} "
+        f"(positives={len(ncf_u)} users={num_users} items={num_items} "
+        f"d=32 bs=8192)"
+    )
+    ncf_p50 = ncf_serving_p50(ncf_state, num_users, num_items)
+    log(f"# ncf serving_p50={ncf_p50:.3f}ms")
+
     model = build_als_model(state, num_users, num_items)
     p50_single = serving_p50_single(model, num_users)
-    p50_conc = serving_p50_concurrent(model, num_users)
+    p50_conc, p99_conc = serving_p50_concurrent(model, num_users)
     log(
         f"# serving_p50={p50_single:.3f}ms "
-        f"serving_p50_concurrent32={p50_conc:.3f}ms (target <10ms)"
+        f"serving_p50_concurrent32={p50_conc:.3f}ms "
+        f"p99_concurrent32={p99_conc:.3f}ms (target <10ms)"
     )
 
     print(
@@ -384,6 +505,9 @@ def main() -> None:
                 "map_at_10_popularity_baseline": round(map_pop, 4),
                 "serving_p50_ms": round(p50_single, 3),
                 "serving_p50_concurrent32_ms": round(p50_conc, 3),
+                "serving_p99_concurrent32_ms": round(p99_conc, 3),
+                "ncf_epochs_per_s": round(ncf_eps, 4),
+                "ncf_serving_p50_ms": round(ncf_p50, 3),
             }
         )
     )

@@ -139,18 +139,67 @@ class NCFState:
     config: NCFParams
 
 
-def make_train_step(optimizer):
-    """The single compiled train step: grad + all-reduce (by GSPMD) + Adam."""
+#: compiled-epoch cache, like ops.als._STEP_CACHE: a warmup call compiles,
+#: subsequent same-shape trains only execute (num_epochs/seed excluded)
+_EPOCH_CACHE: dict = {}
+_EPOCH_CACHE_MAX = 8
+
+
+def _get_epoch_fn(
+    n_steps: int, batch_size: int, n_items: int, lr: float, mesh_key
+):
+    key = (n_steps, batch_size, n_items, lr, mesh_key)
+    hit = _EPOCH_CACHE.get(key)
+    if hit is not None:
+        return hit
+    while len(_EPOCH_CACHE) >= _EPOCH_CACHE_MAX:
+        del _EPOCH_CACHE[next(iter(_EPOCH_CACHE))]
+    optimizer = optax.adam(lr)
+    pair = (optimizer, make_epoch_fn(optimizer, n_steps, batch_size, n_items))
+    _EPOCH_CACHE[key] = pair
+    return pair
+
+
+def make_epoch_fn(optimizer, n_steps: int, batch_size: int, n_items: int):
+    """One compiled program per EPOCH: device-side shuffle, in-step negative
+    sampling, and a lax.scan over all batches.
+
+    This is the TPU-native input pipeline: the positive interactions live on
+    the device for the whole train, so there are no per-batch host
+    ``device_put``s to prefetch around — the "double buffering" problem is
+    dissolved rather than solved.  Per epoch the host does exactly one
+    dispatch; gradients/updates stay fused into the scan body (grad +
+    GSPMD-inserted all-reduce + Adam).
+    """
 
     @jax.jit
-    def step(params, opt_state, user_idx, pos_idx, neg_idx, valid):
-        loss, grads = jax.value_and_grad(bpr_loss)(
-            params, user_idx, pos_idx, neg_idx, valid
+    def epoch(params, opt_state, u_all, i_all, valid_all, key):
+        kperm, kneg = jax.random.split(key)
+        perm = jax.random.permutation(kperm, u_all.shape[0])
+        us = u_all[perm].reshape(n_steps, batch_size)
+        ps = i_all[perm].reshape(n_steps, batch_size)
+        vs = valid_all[perm].reshape(n_steps, batch_size)
+        # one sampled negative per positive per step; extra negatives come
+        # from running more epochs (same expected update count)
+        negs = jax.random.randint(
+            kneg, (n_steps, batch_size), 0, n_items, dtype=jnp.int32
         )
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
 
-    return step
+        def body(carry, xs):
+            params, opt_state = carry
+            u, pos, neg, valid = xs
+            loss, grads = jax.value_and_grad(bpr_loss)(
+                params, u, pos, neg, valid
+            )
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), (us, ps, negs, vs)
+        )
+        return params, opt_state, losses.mean()
+
+    return epoch
 
 
 def train_ncf(
@@ -165,9 +214,9 @@ def train_ncf(
 
     With a mesh, tables are placed row-sharded over ``model`` and batches
     sharded over ``data``; single-device runs skip placement entirely.
+    The interaction stream is staged to the device once; see make_epoch_fn.
     """
     p = params or NCFParams()
-    rng = np.random.default_rng(p.seed)
 
     # pad table rows for even model-axis sharding
     model_par = mesh.shape.get("model", 1) if mesh is not None else 1
@@ -175,7 +224,6 @@ def train_ncf(
     n_items_pad = ((n_items + model_par - 1) // model_par) * model_par
 
     net = init_ncf(jax.random.PRNGKey(p.seed), n_users_pad, n_items_pad, p)
-    optimizer = optax.adam(p.learning_rate)
 
     data_sharding = None
     if mesh is not None:
@@ -183,36 +231,38 @@ def train_ncf(
         net = jax.device_put(net, shardings)
         if "data" in mesh.shape:
             data_sharding = NamedSharding(mesh, PSpec("data"))
-    opt_state = optimizer.init(net)
-    step = make_train_step(optimizer)
 
     n_pos = len(user_idx)
     bs = min(p.batch_size, max(n_pos, 1))
     data_par = mesh.shape.get("data", 1) if mesh is not None else 1
     bs = ((bs + data_par - 1) // data_par) * data_par
+    n_steps = max((n_pos + bs - 1) // bs, 1)
+    optimizer, epoch_fn = _get_epoch_fn(
+        n_steps, bs, n_items, p.learning_rate, mesh
+    )
+    opt_state = optimizer.init(net)
 
+    # stage the full interaction stream on device once (valid masks the
+    # padding up to n_steps * bs)
+    total = n_steps * bs
+    u_all = np.zeros(total, np.int32)
+    i_all = np.zeros(total, np.int32)
+    valid_all = np.zeros(total, np.float32)
+    u_all[:n_pos] = user_idx
+    i_all[:n_pos] = item_idx
+    valid_all[:n_pos] = 1.0
+    if data_sharding is not None:
+        u_all, i_all, valid_all = (
+            jax.device_put(x, data_sharding) for x in (u_all, i_all, valid_all)
+        )
+    else:
+        u_all, i_all, valid_all = map(jnp.asarray, (u_all, i_all, valid_all))
+
+    key = jax.random.PRNGKey(p.seed)
     last_loss = None
     for _ in range(p.num_epochs):
-        order = rng.permutation(n_pos)
-        for start in range(0, n_pos, bs):
-            sel = order[start : start + bs]
-            u = user_idx[sel].astype(np.int32)
-            pos = item_idx[sel].astype(np.int32)
-            # one sampled negative per positive per step; extra negatives
-            # come from running more epochs (same expected update count)
-            neg = rng.integers(0, n_items, len(sel), dtype=np.int32)
-            valid = np.ones(len(sel), np.float32)
-            if len(sel) < bs:  # static shapes: pad the tail batch
-                pad = bs - len(sel)
-                u = np.pad(u, (0, pad))
-                pos = np.pad(pos, (0, pad))
-                neg = np.pad(neg, (0, pad))
-                valid = np.pad(valid, (0, pad))
-            if data_sharding is not None:
-                u, pos, neg, valid = (
-                    jax.device_put(x, data_sharding) for x in (u, pos, neg, valid)
-                )
-            net, opt_state, last_loss = step(net, opt_state, u, pos, neg, valid)
+        key, ek = jax.random.split(key)
+        net, opt_state, last_loss = epoch_fn(net, opt_state, u_all, i_all, valid_all, ek)
     if last_loss is not None:
         jax.block_until_ready(last_loss)
     return NCFState(params=net, n_users=n_users, n_items=n_items, config=p)

@@ -120,11 +120,72 @@ def initialize_distributed() -> None:
     and DCN across slices.  No-op for single-process runs.
     """
     if os.environ.get("PIO_COORDINATOR_ADDRESS"):
+        num_processes = int(os.environ.get("PIO_NUM_PROCESSES", "1"))
+        if num_processes > 1 and os.environ.get("JAX_PLATFORMS", "").startswith(
+            "cpu"
+        ):
+            # CPU multi-process (the local[*]-style test topology) needs a
+            # real collectives implementation; the default 'none' silently
+            # builds a single-process client (process_count() == 1)
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
         jax.distributed.initialize(
             coordinator_address=os.environ["PIO_COORDINATOR_ADDRESS"],
-            num_processes=int(os.environ.get("PIO_NUM_PROCESSES", "1")),
+            num_processes=num_processes,
             process_id=int(os.environ.get("PIO_PROCESS_ID", "0")),
         )
+
+
+def balance_local_chunks(
+    arrays: Sequence[np.ndarray], multiple: int
+) -> tuple[list[np.ndarray], np.ndarray]:
+    """Equalize per-process COO chunk lengths for a global data-sharded array.
+
+    Each process holds a different number of locally-read rows (its event
+    shards are not perfectly balanced); a global jax.Array needs every
+    process to contribute the same length.  All-gathers the local lengths,
+    pads every array to the common (chunk-aligned) target with zeros, and
+    returns the padded arrays plus a float32 valid-mask (1.0 real rows) —
+    the same weight-0-padding trick train_als uses, so padding rows are
+    mathematically inert.
+    """
+    n_local = len(arrays[0])
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        lens = multihost_utils.process_allgather(np.asarray(n_local))
+        max_n = int(np.max(lens))
+    else:
+        max_n = n_local
+    target = max((max_n + multiple - 1) // multiple * multiple, multiple)
+    out = []
+    for a in arrays:
+        padded = np.zeros(target, a.dtype)
+        padded[:n_local] = a
+        out.append(padded)
+    valid = np.zeros(target, np.float32)
+    valid[:n_local] = 1.0
+    return out, valid
+
+
+def global_data_array(mesh: Mesh, local: np.ndarray, axis: str = "data"):
+    """Assemble a global jax.Array sharded along ``axis`` from each
+    process's local chunk (single-process: plain sharded device_put)."""
+    sharding = NamedSharding(mesh, PartitionSpec(axis))
+    if jax.process_count() == 1:
+        return jax.device_put(local, sharding)
+    return jax.make_array_from_process_local_data(sharding, local)
+
+
+def global_replicated_array(mesh: Mesh, value) -> jax.Array:
+    """Replicate a host array over every device of a (possibly
+    multi-process) mesh; every process must pass the same value."""
+    value = np.asarray(value)
+    sharding = NamedSharding(mesh, PartitionSpec(*([None] * value.ndim)))
+    if jax.process_count() == 1:
+        return jax.device_put(value, sharding)
+    return jax.make_array_from_callback(
+        value.shape, sharding, lambda idx: value[idx]
+    )
 
 
 def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0, fill=0):

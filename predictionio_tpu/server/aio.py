@@ -256,3 +256,8 @@ class AsyncAppServer:
             self._thread.join(timeout=5)
         else:
             self._stopped.wait(timeout=5)
+        # release the app's micro-batch worker thread (if any) so repeated
+        # deploy/shutdown cycles don't accumulate idle executors
+        batcher = getattr(self.app, "microbatcher", None)
+        if batcher is not None:
+            batcher.close()

@@ -204,3 +204,6 @@ class AppServer:
         self.httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        batcher = getattr(self.app, "microbatcher", None)
+        if batcher is not None:
+            batcher.close()

@@ -307,12 +307,35 @@ def create_prediction_server_app(
                     log.error("feedback event failed: %s", e)
             return rendered
 
+        def _predict_bisect(parsed, idxs, out, depth=0):
+            """Batched predict with bisection fault isolation: a failing
+            wave splits in half and each half retries batched, so P poison
+            queries cost O(P log B) extra dispatches instead of turning the
+            whole wave into O(B) solo predicts."""
+            try:
+                results = deployed.predict_batch([parsed[i][1] for i in idxs])
+            except Exception as e:
+                if len(idxs) == 1:
+                    out[idxs[0]] = ("err", e)
+                    return
+                if depth == 0:
+                    log.exception(
+                        "wave predict failed; bisecting to isolate"
+                    )
+                mid = len(idxs) // 2
+                _predict_bisect(parsed, idxs[:mid], out, depth + 1)
+                _predict_bisect(parsed, idxs[mid:], out, depth + 1)
+                return
+            for i, (q, pred) in zip(idxs, results):
+                out[i] = ("pred", (q, pred))
+
         def _serve_wave(payloads):
             """Whole wave on the worker thread: extract + vectorized predict
             + render/plugins/feedback.  Returns per item one of
             ("ok", rendered) | ("bad", err) -> 400 | ("err", err) -> 500;
-            a poison query degrades only itself (per-item retry), never the
-            rest of the wave."""
+            a poison query degrades only itself, never the rest of the
+            wave, and a plugin/feedback failure on one item never re-runs
+            prediction for the others."""
             parsed: list[tuple[str, Any]] = []
             for pl in payloads:
                 try:
@@ -322,23 +345,15 @@ def create_prediction_server_app(
             out: list[Any] = list(parsed)
             ok_idx = [i for i, (tag, _) in enumerate(parsed) if tag == "q"]
             if ok_idx:
+                _predict_bisect(parsed, ok_idx, out)
+            for i, entry in enumerate(out):
+                if entry[0] != "pred":
+                    continue
+                q, pred = entry[1]
                 try:
-                    results = deployed.predict_batch(
-                        [parsed[i][1] for i in ok_idx]
-                    )
-                    for i, (q, pred) in zip(ok_idx, results):
-                        out[i] = ("ok", _postprocess(payloads[i], q, pred))
-                except Exception:
-                    # fault isolation: retry each item solo
-                    log.exception(
-                        "wave predict failed; retrying queries individually"
-                    )
-                    for i in ok_idx:
-                        try:
-                            q, pred = deployed.predict(parsed[i][1])
-                            out[i] = ("ok", _postprocess(payloads[i], q, pred))
-                        except Exception as e:
-                            out[i] = ("err", e)
+                    out[i] = ("ok", _postprocess(payloads[i], q, pred))
+                except Exception as e:  # plugin error: only this item fails
+                    out[i] = ("err", e)
             return out
 
         batcher = MicroBatcher(_serve_wave, max_batch=max_batch)

@@ -169,7 +169,12 @@ class TestS3Models:
 
 
 _TRAIN_SCRIPT = r"""
-import sys
+import os, sys
+# select cpu programmatically (env-var at startup is consumed by the machine
+# image's site profile and pins the tunneled TPU backend; see conftest.py)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
 from predictionio_tpu.core.base import EngineContext
 from predictionio_tpu.core.workflow import run_train
 from predictionio_tpu.data.event import Event
@@ -205,7 +210,12 @@ print(inst.id)
 """
 
 _SERVE_SCRIPT = r"""
-import sys
+import os, sys
+# select cpu programmatically (env-var at startup is consumed by the machine
+# image's site profile and pins the tunneled TPU backend; see conftest.py)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
 from predictionio_tpu.core.base import EngineContext
 from predictionio_tpu.data.storage.config import get_storage
 from predictionio_tpu.models.recommendation.engine import (
@@ -237,11 +247,8 @@ class TestCrossProcessDeploy:
         """Train in one OS process, deploy + predict from a second one that
         shares only the store path (the train-here/serve-there contract the
         remote model stores exist for)."""
-        env = dict(
-            os.environ,
-            PIO_HOME=str(tmp_path / "home"),
-            JAX_PLATFORMS="cpu",
-        )
+        env = dict(os.environ, PIO_HOME=str(tmp_path / "home"))
+        env.pop("JAX_PLATFORMS", None)  # set inside the scripts instead
         train = subprocess.run(
             [sys.executable, "-c", _TRAIN_SCRIPT],
             capture_output=True, text=True, env=env, timeout=300,

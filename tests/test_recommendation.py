@@ -180,3 +180,84 @@ class TestSanity:
             recommendation_engine().train(
                 EngineContext(storage=storage), make_params(app="empty")
             )
+
+
+class TestFastEvalTemplate:
+    """FastEvalEngineTest.scala semantics on the real ALS template: a
+    3-variant x 5-fold sweep reads the datasource once, prepares once, and
+    trains one model set per distinct algo-params (x folds) — with results
+    identical to the non-memoized engine, and the run landing on the
+    dashboard."""
+
+    def _sweep(self):
+        return engine_params_list(
+            "movies",
+            ranks=(4, 6),
+            regs=(0.05,),
+            num_iterations=3,
+            eval_params=EvalParams(k_fold=5, query_num=5, rating_threshold=4.0),
+        ) + engine_params_list(
+            "movies",
+            ranks=(4,),
+            regs=(10.0,),
+            num_iterations=3,
+            eval_params=EvalParams(k_fold=5, query_num=5, rating_threshold=4.0),
+        )
+
+    def test_cache_hits_at_template_scale(self, movie_app):
+        from predictionio_tpu.eval import FastEvalEngine
+
+        storage = movie_app
+        ctx = EngineContext(storage=storage, mode="eval")
+        sweep = self._sweep()
+        assert len(sweep) == 3
+        fast = FastEvalEngine.from_engine(recommendation_engine())
+        result = run_evaluation(
+            fast, sweep, PrecisionAtK(k=5), ctx=ctx, storage=storage
+        )
+        assert len(result.records) == 3
+        # one datasource read (all variants share DataSourceParams), one
+        # prepare, one train key per distinct algo params
+        assert fast.counts["datasource"] == 1
+        assert fast.counts["preparator"] == 1
+        assert fast.counts["train"] == 3
+
+    def test_fast_matches_slow_on_real_als(self, movie_app):
+        from predictionio_tpu.eval import FastEvalEngine
+
+        storage = movie_app
+        ctx = EngineContext(storage=storage, mode="eval")
+        sweep = self._sweep()
+        slow = run_evaluation(
+            recommendation_engine(), sweep, PrecisionAtK(k=5),
+            ctx=ctx, storage=storage,
+        )
+        fast = run_evaluation(
+            FastEvalEngine.from_engine(recommendation_engine()), sweep,
+            PrecisionAtK(k=5), ctx=ctx, storage=storage,
+        )
+        assert [r.score for r in fast.records] == pytest.approx(
+            [r.score for r in slow.records]
+        )
+        assert fast.best_idx == slow.best_idx
+
+    def test_dashboard_renders_completed_run(self, movie_app):
+        from predictionio_tpu.eval import FastEvalEngine
+        from predictionio_tpu.server.dashboard import create_dashboard_app
+
+        storage = movie_app
+        ctx = EngineContext(storage=storage, mode="eval")
+        run_evaluation(
+            FastEvalEngine.from_engine(recommendation_engine()),
+            self._sweep(), PrecisionAtK(k=5), ctx=ctx, storage=storage,
+            evaluation_class="recommendation.sweep",
+        )
+        app = create_dashboard_app(storage)
+        from predictionio_tpu.server.httpd import Request
+
+        resp = app.handle(
+            Request(method="GET", path="/", query={}, headers={}, body=b"")
+        )
+        html = resp.body if isinstance(resp.body, str) else resp.body.decode()
+        assert "recommendation.sweep" in html
+        assert "Precision@5" in html or "EVALCOMPLETED" in html

@@ -263,3 +263,76 @@ class TestMicrobatchedQueries:
             assert len(body["itemScores"]) == 3
         waves = deployed_server.app.microbatcher.wave_sizes
         assert sum(k * v for k, v in waves.items()) == 48
+
+
+class TestPoisonQueryBisection:
+    """A poison query in a wave costs O(log B) extra batched dispatches and
+    fails alone; healthy queries in the same wave still answer 200."""
+
+    def _server(self):
+        import threading
+        import types
+
+        from predictionio_tpu.core.base import Algorithm, FirstServing
+        from predictionio_tpu.server.aio import AsyncAppServer
+        from predictionio_tpu.server.prediction_server import (
+            DeployedEngine,
+            create_prediction_server_app,
+        )
+
+        calls = {"batch": 0}
+
+        class PoisonAlgo(Algorithm):
+            def train(self, ctx, pd):
+                return None
+
+            def predict(self, model, q):
+                if q.get("user") == "poison":
+                    raise RuntimeError("poison query")
+                return {"echo": q["user"]}
+
+            def batch_predict(self, model, iq):
+                calls["batch"] += 1
+                return [(i, self.predict(model, q)) for i, q in iq]
+
+        class DictQueryEngine:
+            def params_from_json(self, payload):
+                return None
+
+        deployed = DeployedEngine.__new__(DeployedEngine)
+        deployed._lock = threading.RLock()
+        deployed.instance = types.SimpleNamespace(id="poison-test")
+        deployed.storage = None
+        deployed.algorithms = [PoisonAlgo()]
+        deployed.models = [None]
+        deployed.serving = FirstServing()
+        deployed.engine = DictQueryEngine()
+        deployed.extract_query = lambda payload: dict(payload)
+        app = create_prediction_server_app(deployed, use_microbatch=True)
+        return AsyncAppServer(app, "127.0.0.1", 0).start_background(), calls
+
+    def test_poison_fails_alone_with_log_cost(self):
+        server, calls = self._server()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            users = ["poison" if i == 5 else f"u{i}" for i in range(16)]
+
+            def post(u):
+                try:
+                    return _post(base + "/queries.json", {"user": u, "num": 1})
+                except urllib.error.HTTPError as e:
+                    return e.code, None
+
+            with ThreadPoolExecutor(16) as ex:
+                results = list(ex.map(post, users))
+            for u, (status, body) in zip(users, results):
+                if u == "poison":
+                    assert status == 500
+                else:
+                    assert status == 200, (u, status)
+                    assert body == {"echo": u}
+            # bisection bound: far fewer batched calls than one per item
+            waves = sum(server.app.microbatcher.wave_sizes.values())
+            assert calls["batch"] <= waves + 2 * 5  # ceil(log2(16))=4 splits
+        finally:
+            server.shutdown()

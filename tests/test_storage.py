@@ -1,7 +1,13 @@
-"""Storage SPI tests: event DAOs (sqlite + parquet), metadata DAOs, store
-facades.  The module-level ``storage`` fixture overrides the conftest one to
-run every DAO test against BOTH event backends."""
+"""Storage SPI tests: event DAOs (sqlite + parquet + live postgres when one
+is reachable), metadata DAOs, store facades.  The module-level ``storage``
+fixture overrides the conftest one to run every DAO test against every
+backend; the ``postgres`` param needs a live server (PIO_TEST_POSTGRES_URL,
+or local initdb/pg_ctl binaries + psycopg) and skips with a reason
+otherwise."""
 
+import os
+import shutil
+import subprocess
 from datetime import datetime, timezone
 
 import numpy as np
@@ -18,8 +24,50 @@ from predictionio_tpu.data.storage.base import (
 from predictionio_tpu.data.store import AppNotFoundError, LEventStore, PEventStore
 
 
-@pytest.fixture(params=["sqlite", "parquet"])
-def storage(request, tmp_path):
+@pytest.fixture(scope="session")
+def pg_server(tmp_path_factory):
+    """A throwaway local PostgreSQL server, if the environment can host one.
+
+    Yields a base URL or None (callers skip).  Preference order: an
+    operator-provided PIO_TEST_POSTGRES_URL, then initdb/pg_ctl binaries.
+    """
+    url = os.environ.get("PIO_TEST_POSTGRES_URL")
+    if url:
+        yield url
+        return
+    initdb, pg_ctl = shutil.which("initdb"), shutil.which("pg_ctl")
+    try:
+        import psycopg  # noqa: F401
+    except ImportError:
+        psycopg = None
+    if not (initdb and pg_ctl and psycopg):
+        yield None
+        return
+    d = tmp_path_factory.mktemp("pgdata")
+    sock = tmp_path_factory.mktemp("pgsock")
+    subprocess.run(
+        [initdb, "-D", str(d), "-U", "pio", "--auth=trust"],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        [pg_ctl, "-D", str(d), "-o", f"-c listen_addresses='' -k {sock}",
+         "-w", "start"],
+        check=True, capture_output=True,
+    )
+    try:
+        yield f"postgresql://pio@/postgres?host={sock}"
+    finally:
+        subprocess.run(
+            [pg_ctl, "-D", str(d), "-m", "immediate", "stop"],
+            capture_output=True,
+        )
+
+
+_pg_db_counter = [0]
+
+
+@pytest.fixture(params=["sqlite", "parquet", "postgres"])
+def storage(request, tmp_path, pg_server):
     from predictionio_tpu.data.storage.config import (
         StorageConfig,
         reset_storage,
@@ -33,6 +81,32 @@ def storage(request, tmp_path):
             "PIO_STORAGE_SOURCES_PQ_NSHARDS": "4",
             "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "pio_event",
             "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "PQ",
+        }
+    elif request.param == "postgres":
+        if pg_server is None:
+            pytest.skip(
+                "no live PostgreSQL: set PIO_TEST_POSTGRES_URL or install "
+                "server binaries (initdb/pg_ctl) + psycopg"
+            )
+        # fresh database per test for isolation; rewrite only the URL's
+        # path component (a naive str.replace would mangle usernames like
+        # postgres@ or silently no-op on custom database names)
+        from urllib.parse import urlsplit, urlunsplit
+
+        import psycopg
+
+        _pg_db_counter[0] += 1
+        dbname = f"pio_test_{os.getpid()}_{_pg_db_counter[0]}"
+        with psycopg.connect(pg_server, autocommit=True) as conn:
+            conn.execute(f"CREATE DATABASE {dbname}")
+        parts = urlsplit(pg_server)
+        url = urlunsplit(parts._replace(path=f"/{dbname}"))
+        env |= {
+            "PIO_STORAGE_SOURCES_PG_TYPE": "postgres",
+            "PIO_STORAGE_SOURCES_PG_URL": url,
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "PG",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "PG",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "PG",
         }
     rt = reset_storage(StorageConfig.from_env(env))
     yield rt
@@ -312,3 +386,118 @@ class TestFacades:
         m.insert("xyz", b"blob")
         assert m.get("xyz") == b"blob"
         assert m.delete("xyz") and not m.delete("xyz")
+
+
+class TestPostgresDialect:
+    """Server-free conformance: every SQL statement the DAOs actually emit
+    must translate to well-formed PostgreSQL.  Captures the live corpus by
+    instrumenting SQLiteClient during a full DAO workout, then checks each
+    translation — so a new DAO query that the regex rules miss fails here,
+    not on the first real server."""
+
+    @pytest.fixture()
+    def sql_corpus(self, tmp_path, monkeypatch):
+        from predictionio_tpu.data.storage import sqlite_backend as sb
+
+        captured: list[str] = []
+        orig_exec = sb.SQLiteClient.execute
+        orig_many = sb.SQLiteClient.executemany
+        orig_query = sb.SQLiteClient.query
+        monkeypatch.setattr(
+            sb.SQLiteClient, "execute",
+            lambda self, sql, params=(): (captured.append(sql),
+                                          orig_exec(self, sql, params))[1],
+        )
+        monkeypatch.setattr(
+            sb.SQLiteClient, "executemany",
+            lambda self, sql, rows: (captured.append(sql),
+                                     orig_many(self, sql, rows))[1],
+        )
+        monkeypatch.setattr(
+            sb.SQLiteClient, "query",
+            lambda self, sql, params=(): (captured.append(sql),
+                                          orig_query(self, sql, params))[1],
+        )
+        from predictionio_tpu.data.storage.config import (
+            StorageConfig,
+            reset_storage,
+        )
+
+        rt = reset_storage(
+            StorageConfig.from_env({"PIO_HOME": str(tmp_path / "h")})
+        )
+        # full DAO workout: metadata CRUD, events, instances, models
+        app_id = rt.apps().insert(App(id=0, name="dialect"))
+        rt.apps().get(app_id); rt.apps().get_by_name("dialect")
+        rt.apps().get_all()
+        rt.access_keys().insert(AccessKey(key="k1", appid=app_id, events=()))
+        rt.access_keys().get("k1"); rt.access_keys().get_by_appid(app_id)
+        ch = rt.channels().insert(Channel(id=0, name="ch", appid=app_id))
+        rt.channels().get_by_appid(app_id)
+        le = rt.l_events()
+        le.init(app_id)
+        eid = le.insert(mk("rate", "u1", 1, target="i1",
+                           props={"rating": 4.0}), app_id)
+        le.insert_batch([mk("view", "u2", 2), mk("buy", "u3", 3)], app_id)
+        le.get(eid, app_id)
+        list(le.find(app_id, filter=EventFilter(
+            event_names=("rate",), entity_type="user", entity_id="u1",
+            start_time=t(0), until_time=t(9))))
+        le.delete(eid, app_id)
+        pe = rt.p_events()
+        pe.find(app_id)
+        inst = EngineInstance(id="inst1", status="INIT",
+                              start_time=t(0), end_time=t(1),
+                              engine_id="e", engine_version="1",
+                              engine_variant="default", engine_factory="f")
+        rt.engine_instances().insert(inst)
+        rt.engine_instances().update(inst.completed())
+        rt.engine_instances().get("inst1")
+        rt.engine_instances().get_latest_completed("e", "1", "default")
+        rt.models().insert("inst1", b"blob")
+        rt.models().get("inst1"); rt.models().delete("inst1")
+        le.remove(app_id)
+        rt.channels().delete(ch)
+        rt.apps().delete(app_id)
+        rt.close()
+        return captured
+
+    def test_corpus_translates_clean(self, sql_corpus):
+        from predictionio_tpu.data.storage.postgres_backend import _translate
+
+        assert len(sql_corpus) > 25, "workout captured too few statements"
+        for sql in set(sql_corpus):
+            out = _translate(sql)
+            up = out.upper()
+            assert "?" not in out, f"untranslated placeholder: {out}"
+            assert "INSERT OR REPLACE" not in up, out
+            assert "INSERT OR IGNORE" not in up, out
+            assert "AUTOINCREMENT" not in up, out
+            # BLOB must be gone as a column type (word-boundary check)
+            import re as _re
+
+            assert not _re.search(r"\bBLOB\b", up), out
+            if "ON CONFLICT" in up:
+                # well-formed: conflict target column present + DO action
+                assert _re.search(
+                    r"ON CONFLICT \([\w]+\) DO (UPDATE SET|NOTHING)", out
+                ), out
+            if _re.match(r"\s*INSERT INTO pio_(apps|channels)\b", out,
+                         _re.I):
+                assert out.rstrip().endswith("RETURNING id"), out
+
+    def test_cursor_shim_lastrowid(self):
+        from predictionio_tpu.data.storage.postgres_backend import _Cursor
+
+        class FakePG:
+            description = [("id",)]
+
+            def fetchone(self):
+                return (42,)
+
+        assert _Cursor(FakePG()).lastrowid == 42
+
+        class FakeNoRows:
+            description = None
+
+        assert _Cursor(FakeNoRows()).lastrowid is None
