@@ -208,17 +208,11 @@ def build_als_model(state, num_users, num_items):
     )
 
 
-def ncf_serving_p50(ncf_state, num_users, num_items, n=200):
-    """NCF-template serving path: vocab lookup + on-device score_all_items
-    top-k through NCFAlgorithm.predict."""
+def build_ncf_model(ncf_state, num_users, num_items):
     from predictionio_tpu.data.bimap import BiMap
-    from predictionio_tpu.models.ncf.engine import (
-        NCFAlgorithm,
-        NCFModel,
-        Query,
-    )
+    from predictionio_tpu.models.ncf.engine import NCFModel
 
-    model = NCFModel(
+    return NCFModel(
         state=ncf_state,
         user_vocab=BiMap.from_keys(
             np.asarray([str(u) for u in range(num_users)])
@@ -227,6 +221,16 @@ def ncf_serving_p50(ncf_state, num_users, num_items, n=200):
             np.asarray([str(i) for i in range(num_items)])
         ),
     )
+
+
+def ncf_serving_p50(model, num_users, n=200):
+    """NCF-template solo serving: vocab lookup + on-device score_all_items
+    top-k through NCFAlgorithm.predict.  NOTE: each solo query is one
+    device dispatch; on a tunneled single-chip dev box that round trip
+    alone is ~100 ms, so the concurrent (micro-batched) number is the
+    representative one."""
+    from predictionio_tpu.models.ncf.engine import NCFAlgorithm, Query
+
     algo = NCFAlgorithm()
     algo.predict(model, Query(user="0", num=K))  # compile
     lat = []
@@ -479,8 +483,32 @@ def main() -> None:
         f"(positives={len(ncf_u)} users={num_users} items={num_items} "
         f"d=32 bs=8192)"
     )
-    ncf_p50 = ncf_serving_p50(ncf_state, num_users, num_items)
-    log(f"# ncf serving_p50={ncf_p50:.3f}ms")
+    from predictionio_tpu.models.ncf.engine import _score_topk_batch
+
+    ncf_model = build_ncf_model(ncf_state, num_users, num_items)
+    ncf_p50 = ncf_serving_p50(ncf_model, num_users, n=60)
+    # device-level wave cost: one 32-query micro-batch wave scored on the
+    # chip (what a production TPU-VM serving path pays per wave, without
+    # this dev box's ~100 ms tunnel round trip per dispatch)
+    import jax as _jax
+
+    wave_users = np.arange(32, dtype=np.int32)
+    _jax.block_until_ready(
+        _score_topk_batch(ncf_state.params, wave_users, num_items, K)
+    )
+    wave_ts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        _jax.block_until_ready(
+            _score_topk_batch(ncf_state.params, wave_users, num_items, K)
+        )
+        wave_ts.append(time.perf_counter() - t0)
+    ncf_wave32_ms = min(wave_ts) * 1000
+    log(
+        f"# ncf serving_p50_solo={ncf_p50:.3f}ms (incl. dev-tunnel dispatch "
+        f"RTT ~100ms) wave32_device={ncf_wave32_ms:.3f}ms "
+        f"(~{ncf_wave32_ms / 32:.3f}ms/query batched)"
+    )
 
     model = build_als_model(state, num_users, num_items)
     p50_single = serving_p50_single(model, num_users)
@@ -508,6 +536,7 @@ def main() -> None:
                 "serving_p99_concurrent32_ms": round(p99_conc, 3),
                 "ncf_epochs_per_s": round(ncf_eps, 4),
                 "ncf_serving_p50_ms": round(ncf_p50, 3),
+                "ncf_wave32_device_ms": round(ncf_wave32_ms, 3),
             }
         )
     )

@@ -191,7 +191,9 @@ def create_prediction_server_app(
     access_key: str | None = None,
     plugins: "PluginContext | None" = None,
     use_microbatch: bool = False,
-    max_batch: int = 64,
+    #: waves above ~32 lengthen the tail (a query waits up to two waves);
+    #: measured on the serving bench, 32 minimizes concurrent p99
+    max_batch: int = 32,
 ) -> HTTPApp:
     from predictionio_tpu.server.plugins import PluginContext
 

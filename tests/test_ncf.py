@@ -54,8 +54,8 @@ class TestNCFOps:
             n_users=40,
             n_items=30,
             params=NCFParams(
-                embed_dim=8, mlp_layers=(16, 8), num_epochs=30, batch_size=256,
-                learning_rate=5e-3,
+                embed_dim=8, mlp_layers=(16, 8), num_epochs=150,
+                batch_size=256, learning_rate=5e-3,
             ),
         )
         # user 0 (even cluster) should rank low items above high items
@@ -79,14 +79,14 @@ class TestNCFOps:
             n_users=40,
             n_items=30,
             params=NCFParams(
-                embed_dim=8, mlp_layers=(16, 8), num_epochs=20, batch_size=256,
-                learning_rate=5e-3,
+                embed_dim=8, mlp_layers=(16, 8), num_epochs=150,
+                batch_size=256, learning_rate=5e-3,
             ),
             mesh=mesh,
         )
         # tables were padded to divide the model axis and sharded
-        assert state.params["user_gmf"].shape[0] % 2 == 0
-        assert not state.params["user_gmf"].sharding.is_fully_replicated
+        assert state.params["user_emb"].shape[0] % 2 == 0
+        assert not state.params["user_emb"].sharding.is_fully_replicated
         assert state.params["mlp"][0]["w"].sharding.is_fully_replicated
         scores = np.asarray(score_all_items(state.params, jnp.int32(0)))
         assert np.isfinite(scores).all()
@@ -153,3 +153,110 @@ class TestNCFTemplate:
         assert len(result.item_scores) == 5
         scores = [s.score for s in result.item_scores]
         assert scores == sorted(scores, reverse=True)
+
+
+class TestNCFBatchPredict:
+    def test_batch_matches_single_and_isolates_unknowns(self, storage):
+        from predictionio_tpu.data.bimap import BiMap
+        from predictionio_tpu.models.ncf.engine import (
+            NCFAlgorithm,
+            NCFModel,
+            Query,
+        )
+        from predictionio_tpu.ops.ncf import NCFParams, train_ncf
+
+        rng = np.random.default_rng(0)
+        state = train_ncf(
+            rng.integers(0, 20, 400).astype(np.int32),
+            rng.integers(0, 15, 400).astype(np.int32),
+            20, 15,
+            params=NCFParams(embed_dim=8, mlp_layers=(16, 8),
+                             num_epochs=2, batch_size=64),
+        )
+        model = NCFModel(
+            state=state,
+            user_vocab=BiMap.from_keys(
+                np.asarray([f"u{u}" for u in range(20)])
+            ),
+            item_vocab=BiMap.from_keys(
+                np.asarray([f"i{i}" for i in range(15)])
+            ),
+        )
+        algo = NCFAlgorithm()
+        queries = [
+            Query(user="u1", num=3),
+            Query(user="nope", num=3),   # unknown user -> empty result
+            Query(user="u5", num=5),     # mixed num in one wave
+        ]
+        batch = dict(algo.batch_predict(model, list(enumerate(queries))))
+        assert len(batch) == 3
+        assert batch[1].item_scores == ()
+        for idx in (0, 2):
+            solo = algo.predict(model, queries[idx])
+            got = [(s.item, round(s.score, 4)) for s in batch[idx].item_scores]
+            want = [(s.item, round(s.score, 4)) for s in solo.item_scores]
+            assert got == want
+            assert len(got) == queries[idx].num
+
+
+class TestCheckpointMigration:
+    def test_pre_packed_checkpoint_still_deploys(self):
+        """Checkpoints saved with the old four-table layout (user_gmf/
+        item_gmf/user_mlp/item_mlp) must load into the packed layout."""
+        import math
+
+        from predictionio_tpu.core.base import EngineContext
+        from predictionio_tpu.data.bimap import BiMap
+        from predictionio_tpu.models.ncf.engine import NCFAlgorithm, Query
+        from predictionio_tpu.ops.ncf import NCFParams
+
+        rng = np.random.default_rng(0)
+        d = 8
+        n_u, n_i = 12, 9
+        scale = 1.0 / math.sqrt(d)
+        old_params = {
+            "user_gmf": rng.standard_normal((n_u, d)).astype(np.float32) * scale,
+            "item_gmf": rng.standard_normal((n_i, d)).astype(np.float32) * scale,
+            "user_mlp": rng.standard_normal((n_u, d)).astype(np.float32) * scale,
+            "item_mlp": rng.standard_normal((n_i, d)).astype(np.float32) * scale,
+            "mlp": [
+                {"w": rng.standard_normal((2 * d, 16)).astype(np.float32),
+                 "b": np.zeros(16, np.float32)},
+                {"w": rng.standard_normal((16, 8)).astype(np.float32),
+                 "b": np.zeros(8, np.float32)},
+            ],
+            "out_w": rng.standard_normal((d + 8, 1)).astype(np.float32),
+            "out_b": np.zeros(1, np.float32),
+        }
+        data = {
+            "params": old_params,
+            "n_users": n_u,
+            "n_items": n_i,
+            "config": NCFParams(embed_dim=d, mlp_layers=(16, 8)),
+            "user_vocab": BiMap.from_keys(
+                np.asarray([f"u{u}" for u in range(n_u)])
+            ).to_state(),
+            "item_vocab": BiMap.from_keys(
+                np.asarray([f"i{i}" for i in range(n_i)])
+            ).to_state(),
+        }
+        algo = NCFAlgorithm()
+        model = algo.load_persistent_model(EngineContext(storage=None), data)
+        model.sanity_check()
+        r = algo.predict(model, Query(user="u1", num=3))
+        assert len(r.item_scores) == 3
+        # migrated scores match the old formula computed by hand
+        ue = np.concatenate([old_params["user_gmf"][1],
+                             old_params["user_mlp"][1]])
+        scores = []
+        for i in range(n_i):
+            gmf = ue[:d] * old_params["item_gmf"][i]
+            h = np.concatenate([ue[d:], old_params["item_mlp"][i]])
+            for layer in old_params["mlp"]:
+                h = np.maximum(h @ layer["w"] + layer["b"], 0.0)
+            scores.append(
+                float(np.concatenate([gmf, h]) @ old_params["out_w"][:, 0]
+                      + old_params["out_b"][0])
+            )
+        best = max(range(n_i), key=lambda i: scores[i])
+        assert r.item_scores[0].item == f"i{best}"
