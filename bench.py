@@ -298,63 +298,125 @@ print(json.dumps({"p50_ms": lats[len(lats) // 2] * 1000,
 """
 
 
+_SERVER_SCRIPT = r"""
+# Serving process for the concurrent bench: a FRESH interpreter pinned to
+# cpu, so none of the parent's accelerator-tunnel threads/buffers can stall
+# the event loop (production serving would not co-host training either).
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import threading, types
+import numpy as np
+from bench import build_als_model
+from predictionio_tpu.core.base import FirstServing
+from predictionio_tpu.models.recommendation.engine import ALSAlgorithm
+from predictionio_tpu.server.aio import AsyncAppServer
+from predictionio_tpu.server.prediction_server import (
+    DeployedEngine, create_prediction_server_app,
+)
+
+blob = np.load(sys.argv[1])
+
+class _State:
+    user_factors = blob["U"]
+    item_factors = blob["V"]
+
+model = build_als_model(_State(), len(blob["U"]), len(blob["V"]))
+deployed = DeployedEngine.__new__(DeployedEngine)
+deployed._lock = threading.RLock()
+deployed.instance = types.SimpleNamespace(id="bench")
+deployed.storage = None
+deployed.algorithms = [ALSAlgorithm()]
+deployed.models = [model]
+deployed.serving = FirstServing()
+app = create_prediction_server_app(deployed, use_microbatch=True)
+server = AsyncAppServer(app, "127.0.0.1", 0).start_background()
+print(server.port, flush=True)
+sys.stdin.readline()  # parent closes stdin to stop us
+sizes = sorted(app.microbatcher.wave_sizes.items())
+print(f"waves {sizes}", file=sys.stderr, flush=True)
+server.shutdown()
+"""
+
+
 def serving_p50_concurrent(model, num_users, clients=32, per_client=40):
-    """p50 across 32 concurrent keep-alive clients (a separate process, so
-    client-side GIL load doesn't pollute the measurement) hitting the real
-    asyncio server + micro-batched /queries.json route."""
+    """p50/p99 across 32 concurrent keep-alive clients hitting a real
+    asyncio server + micro-batched /queries.json route.  Server AND load
+    generator each run in their own fresh process; best p99 of 3 rounds
+    (single shared core — any round can be eaten by unrelated scheduling)."""
     import subprocess
-    import threading
-    import types
+    import tempfile
 
-    from predictionio_tpu.core.base import FirstServing
-    from predictionio_tpu.models.recommendation.engine import ALSAlgorithm
-    from predictionio_tpu.server.aio import AsyncAppServer
-    from predictionio_tpu.server.prediction_server import (
-        DeployedEngine,
-        create_prediction_server_app,
+    with tempfile.NamedTemporaryFile(suffix=".npz", delete=False) as f:
+        np.savez(
+            f,
+            U=np.asarray(model.user_factors, np.float32),
+            V=np.asarray(model.item_factors, np.float32),
+        )
+        blob_path = f.name
+    srv = subprocess.Popen(
+        [sys.executable, "-c", _SERVER_SCRIPT, blob_path],
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
     )
-
-    deployed = DeployedEngine.__new__(DeployedEngine)
-    deployed._lock = threading.RLock()
-    deployed.instance = types.SimpleNamespace(id="bench")
-    deployed.storage = None
-    deployed.algorithms = [ALSAlgorithm()]
-    deployed.models = [model]
-    deployed.serving = FirstServing()
-    app = create_prediction_server_app(deployed, use_microbatch=True)
-    server = AsyncAppServer(app, "127.0.0.1", 0).start_background()
-    n_procs = 1  # the asyncio client is cheap; more procs just burn the core
     try:
-        procs = [
-            subprocess.Popen(
+        # handshake with timeout; a dead child must surface its traceback
+        import threading as _threading
+
+        port_line: list = []
+        reader = _threading.Thread(
+            target=lambda: port_line.append(srv.stdout.readline()), daemon=True
+        )
+        reader.start()
+        reader.join(timeout=120)
+        if not port_line or not port_line[0].strip():
+            srv.kill()
+            _, err = srv.communicate(timeout=10)
+            raise RuntimeError(f"bench server failed to start: {err[-1000:]}")
+        port = int(port_line[0])
+        rounds = []
+        for _ in range(3):
+            p = subprocess.run(
                 [
                     sys.executable,
                     "-c",
                     _CLIENT_SCRIPT,
-                    str(server.port),
-                    str(clients // n_procs),
+                    str(port),
+                    str(clients),
                     str(per_client),
                     str(num_users),
                 ],
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
+                capture_output=True,
                 text=True,
+                timeout=300,
             )
-            for _ in range(n_procs)
-        ]
-        p50s, p99s = [], []
-        for p in procs:
-            out, err = p.communicate(timeout=300)
             if p.returncode != 0:
-                raise RuntimeError(f"bench client failed: {err[-500:]}")
-            r = json.loads(out.strip().splitlines()[-1])
-            p50s.append(r["p50_ms"])
-            p99s.append(r["p99_ms"])
-        sizes = sorted(app.microbatcher.wave_sizes.items())
-        log(f"# microbatch waves (size: count): {sizes}")
-        return sum(p50s) / len(p50s), max(p99s)
+                raise RuntimeError(f"bench client failed: {p.stderr[-500:]}")
+            rounds.append(json.loads(p.stdout.strip().splitlines()[-1]))
+        log(
+            "# concurrent rounds: "
+            + " ".join(
+                f"p50={r['p50_ms']:.2f}/p99={r['p99_ms']:.2f}" for r in rounds
+            )
+        )
+        # MEDIAN round by p99: robust to one scheduler-noise round without
+        # cherry-picking the best (single shared core)
+        med = sorted(rounds, key=lambda r: r["p99_ms"])[len(rounds) // 2]
+        return med["p50_ms"], med["p99_ms"]
     finally:
-        server.shutdown()
+        try:
+            srv.stdin.close()
+            _, err = srv.communicate(timeout=10)
+            for line in err.splitlines():
+                if line.startswith("waves "):
+                    log(f"# microbatch {line}")
+        except Exception:
+            srv.kill()
+        os.unlink(blob_path)
 
 
 def main() -> None:
@@ -487,26 +549,31 @@ def main() -> None:
 
     ncf_model = build_ncf_model(ncf_state, num_users, num_items)
     ncf_p50 = ncf_serving_p50(ncf_model, num_users, n=60)
-    # device-level wave cost: one 32-query micro-batch wave scored on the
-    # chip (what a production TPU-VM serving path pays per wave, without
-    # this dev box's ~100 ms tunnel round trip per dispatch)
+    # device-level wave cost: 50 DISTINCT 32-query micro-batch waves
+    # dispatched back-to-back with one final sync — pipelining amortizes
+    # this dev box's ~100 ms tunnel round trip out of the measurement, so
+    # the per-wave figure approximates what a production TPU-VM serving
+    # path pays per wave of 32 queries
     import jax as _jax
+    import jax.numpy as _jnp
 
-    wave_users = np.arange(32, dtype=np.int32)
+    waves = [
+        _jnp.asarray((np.arange(32) * 131 + w * 37) % num_users, _jnp.int32)
+        for w in range(51)
+    ]
     _jax.block_until_ready(
-        _score_topk_batch(ncf_state.params, wave_users, num_items, K)
+        _score_topk_batch(ncf_state.params, waves[0], num_items, K)
     )
-    wave_ts = []
-    for _ in range(10):
-        t0 = time.perf_counter()
-        _jax.block_until_ready(
-            _score_topk_batch(ncf_state.params, wave_users, num_items, K)
-        )
-        wave_ts.append(time.perf_counter() - t0)
-    ncf_wave32_ms = min(wave_ts) * 1000
+    t0 = time.perf_counter()
+    outs = [
+        _score_topk_batch(ncf_state.params, w, num_items, K)
+        for w in waves[1:]
+    ]
+    _jax.block_until_ready(outs)
+    ncf_wave32_ms = (time.perf_counter() - t0) / 50 * 1000
     log(
         f"# ncf serving_p50_solo={ncf_p50:.3f}ms (incl. dev-tunnel dispatch "
-        f"RTT ~100ms) wave32_device={ncf_wave32_ms:.3f}ms "
+        f"RTT ~100ms) wave32_pipelined={ncf_wave32_ms:.3f}ms "
         f"(~{ncf_wave32_ms / 32:.3f}ms/query batched)"
     )
 
@@ -536,7 +603,7 @@ def main() -> None:
                 "serving_p99_concurrent32_ms": round(p99_conc, 3),
                 "ncf_epochs_per_s": round(ncf_eps, 4),
                 "ncf_serving_p50_ms": round(ncf_p50, 3),
-                "ncf_wave32_device_ms": round(ncf_wave32_ms, 3),
+                "ncf_wave32_pipelined_ms": round(ncf_wave32_ms, 3),
             }
         )
     )

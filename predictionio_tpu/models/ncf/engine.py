@@ -153,11 +153,22 @@ class NCFAlgorithm(Algorithm):
             )
         )
 
+    #: device dispatch width for batch serving; bulk callers (batchpredict
+    #: jobs, evaluation folds) are chunked to this so the vmapped MLP
+    #: activations stay [32, n_items, hidden] regardless of input size
+    MAX_WAVE = 32
+
     def batch_predict(self, model: NCFModel, indexed_queries):
-        """Vectorized wave serving: one device dispatch for the whole
-        micro-batch (queries with different ``num`` or unknown users are
-        handled per-row on the host after the shared top-k)."""
+        """Vectorized wave serving: one device dispatch per MAX_WAVE chunk
+        (queries with different ``num`` or unknown users are handled
+        per-row on the host after the shared top-k)."""
         iq = list(indexed_queries)
+        out = []
+        for c0 in range(0, len(iq), self.MAX_WAVE):
+            out.extend(self._predict_wave(model, iq[c0 : c0 + self.MAX_WAVE]))
+        return out
+
+    def _predict_wave(self, model: NCFModel, iq):
         if not iq:
             return []
         n_items = len(model.item_vocab)
