@@ -264,8 +264,11 @@ _CLIENT_SCRIPT = r"""
 # bytes, hand-rolled response framing.  Load generation shares this box's
 # CPU with the server under test (single-core machine image), so every
 # microsecond of client overhead inflates the server's measured latency.
+# Runs ``rounds`` independent rounds, one JSON result line each — spawned
+# ONCE (before the parent deprioritizes itself) so it never inherits a
+# degraded priority.
 import asyncio, json, sys, time
-port, conns, per_conn, num_users = (int(a) for a in sys.argv[1:5])
+port, conns, per_conn, num_users, rounds = (int(a) for a in sys.argv[1:6])
 
 def req_bytes(uid):
     body = b'{"user": "%d", "num": 10}' % uid
@@ -273,9 +276,7 @@ def req_bytes(uid):
             b"Content-Type: application/json\r\n"
             b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
 
-lats = []
-
-async def client(cid):
+async def client(cid, lats):
     reader, writer = await asyncio.open_connection("127.0.0.1", port)
     for q in range(per_conn):
         payload = req_bytes((cid * per_conn + q) % num_users)
@@ -288,13 +289,16 @@ async def client(cid):
         assert head.startswith(b"HTTP/1.1 200"), head[:80] + body[:200]
     writer.close()
 
-async def main():
-    await asyncio.gather(*(client(c) for c in range(conns)))
+async def one_round():
+    lats = []
+    await asyncio.gather(*(client(c, lats) for c in range(conns)))
+    return lats
 
-asyncio.run(main())
-lats.sort()
-print(json.dumps({"p50_ms": lats[len(lats) // 2] * 1000,
-                  "p99_ms": lats[int(len(lats) * 0.99)] * 1000}))
+for _ in range(rounds):
+    lats = sorted(asyncio.run(one_round()))
+    print(json.dumps({"p50_ms": lats[len(lats) // 2] * 1000,
+                      "p99_ms": lats[int(len(lats) * 0.99)] * 1000}),
+          flush=True)
 """
 
 
@@ -343,8 +347,9 @@ server.shutdown()
 def serving_p50_concurrent(model, num_users, clients=32, per_client=40):
     """p50/p99 across 32 concurrent keep-alive clients hitting a real
     asyncio server + micro-batched /queries.json route.  Server AND load
-    generator each run in their own fresh process; best p99 of 3 rounds
-    (single shared core — any round can be eaten by unrelated scheduling)."""
+    generator each run in their own fresh process; the MEDIAN round by p99
+    of 3 is reported (single shared core — any one round can be eaten by
+    unrelated scheduling; median is robust without cherry-picking)."""
     import subprocess
     import tempfile
 
@@ -378,25 +383,53 @@ def serving_p50_concurrent(model, num_users, clients=32, per_client=40):
             _, err = srv.communicate(timeout=10)
             raise RuntimeError(f"bench server failed to start: {err[-1000:]}")
         port = int(port_line[0])
-        rounds = []
-        for _ in range(3):
-            p = subprocess.run(
-                [
-                    sys.executable,
-                    "-c",
-                    _CLIENT_SCRIPT,
-                    str(port),
-                    str(clients),
-                    str(per_client),
-                    str(num_users),
-                ],
-                capture_output=True,
-                text=True,
-                timeout=300,
-            )
-            if p.returncode != 0:
-                raise RuntimeError(f"bench client failed: {p.stderr[-500:]}")
-            rounds.append(json.loads(p.stdout.strip().splitlines()[-1]))
+        # spawn the load generator (all 3 rounds in one process) BEFORE
+        # deprioritizing this process, so it never inherits a degraded
+        # priority — avoids both the unprivileged-renice trap and
+        # preexec_fn's fork-in-threads hazard
+        n_rounds = 3
+        client = subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                _CLIENT_SCRIPT,
+                str(port),
+                str(clients),
+                str(per_client),
+                str(num_users),
+                str(n_rounds),
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        # deprioritize THIS process while the rounds run: accelerator-tunnel
+        # background threads keep burning cycles even though the parent just
+        # waits, and on a single shared core they tax the server+client
+        # (~+7 ms p50 measured).  Only attempted when a probe proves the
+        # priority can be RESTORED (lowering nice needs privilege).
+        prio0 = None
+        try:
+            cur = os.getpriority(os.PRIO_PROCESS, 0)
+            os.setpriority(os.PRIO_PROCESS, 0, cur + 1)
+            os.setpriority(os.PRIO_PROCESS, 0, cur)  # probe restore
+            os.setpriority(os.PRIO_PROCESS, 0, 19)
+            prio0 = cur
+        except (OSError, AttributeError):
+            pass
+        try:
+            out, err = client.communicate(timeout=600)
+        finally:
+            if prio0 is not None:
+                try:
+                    os.setpriority(os.PRIO_PROCESS, 0, prio0)
+                except OSError:
+                    pass
+        if client.returncode != 0:
+            raise RuntimeError(f"bench client failed: {err[-500:]}")
+        rounds = [
+            json.loads(line) for line in out.strip().splitlines()[-n_rounds:]
+        ]
         log(
             "# concurrent rounds: "
             + " ".join(

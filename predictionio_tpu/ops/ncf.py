@@ -219,6 +219,13 @@ def train_ncf(
     With a mesh, tables are placed row-sharded over ``model`` and batches
     sharded over ``data``; single-device runs skip placement entirely.
     The interaction stream is staged to the device once; see make_epoch_fn.
+
+    Multi-process contract: under ``jax.process_count() > 1`` EVERY process
+    must pass the IDENTICAL full interaction arrays (all-gather your local
+    shard rows first, e.g. ``multihost_utils.process_allgather``) — unlike
+    ``ops.als.train_als_global``, which takes pre-sharded per-process
+    chunks.  The global shuffle each epoch needs a consistent global view;
+    device memory still only holds each process's shards.
     """
     p = params or NCFParams()
 
@@ -232,7 +239,19 @@ def train_ncf(
     data_sharding = None
     if mesh is not None:
         shardings = param_shardings(mesh, net)
-        net = jax.device_put(net, shardings)
+        if jax.process_count() > 1:
+            # multi-controller placement: every process computed the same
+            # seed-deterministic init; each materializes only the shards its
+            # local devices own
+            net = jax.tree_util.tree_map(
+                lambda x, s: jax.make_array_from_callback(
+                    np.shape(x), s, lambda idx, x=x: np.asarray(x)[idx]
+                ),
+                net,
+                shardings,
+            )
+        else:
+            net = jax.device_put(net, shardings)
         if "data" in mesh.shape:
             data_sharding = NamedSharding(mesh, PSpec("data"))
 
@@ -256,9 +275,20 @@ def train_ncf(
     i_all[:n_pos] = item_idx
     valid_all[:n_pos] = 1.0
     if data_sharding is not None:
-        u_all, i_all, valid_all = (
-            jax.device_put(x, data_sharding) for x in (u_all, i_all, valid_all)
-        )
+        if jax.process_count() > 1:
+            # every process passes the identical (all-gathered) interaction
+            # stream; device memory still holds only the local shards
+            u_all, i_all, valid_all = (
+                jax.make_array_from_callback(
+                    x.shape, data_sharding, lambda idx, x=x: x[idx]
+                )
+                for x in (u_all, i_all, valid_all)
+            )
+        else:
+            u_all, i_all, valid_all = (
+                jax.device_put(x, data_sharding)
+                for x in (u_all, i_all, valid_all)
+            )
     else:
         u_all, i_all, valid_all = map(jnp.asarray, (u_all, i_all, valid_all))
 
