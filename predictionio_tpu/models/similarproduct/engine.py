@@ -107,11 +107,16 @@ class DataSourceParams:
     channel_name: str | None = None
     #: events treated as interactions; "like"/"dislike" get signed weights
     event_names: tuple[str, ...] = ("view",)
+    #: entity type of the interaction TARGET: "item" for the product
+    #: variants, "user" for the recommended-user variant (users viewing
+    #: users, recommended-user/DataSource.scala)
+    target_entity_type: str = "item"
 
     params_aliases = {
         "appName": "app_name",
         "channelName": "channel_name",
         "eventNames": "event_names",
+        "targetEntityType": "target_entity_type",
     }
 
 
@@ -123,6 +128,7 @@ class SimilarProductDataSource(DataSource):
 
     def read_training(self, ctx: EngineContext) -> TrainingData:
         store = ctx.p_event_store
+        target_type = self.params.target_entity_type
         users = sorted(
             store.aggregate_properties(
                 self.params.app_name, "user", channel_name=self.params.channel_name
@@ -131,14 +137,16 @@ class SimilarProductDataSource(DataSource):
         items = {
             item_id: Item(categories=tuple(props.get_or_else("categories", [])))
             for item_id, props in store.aggregate_properties(
-                self.params.app_name, "item", channel_name=self.params.channel_name
+                self.params.app_name,
+                target_type,
+                channel_name=self.params.channel_name,
             ).items()
         }
         frame = ctx.p_event_store.find(
             self.params.app_name,
             channel_name=self.params.channel_name,
             entity_type="user",
-            target_entity_type="item",
+            target_entity_type=target_type,
             event_names=list(self.params.event_names),
         )
         weights = np.where(frame.event == "dislike", -1.0, 1.0).astype(np.float32)
@@ -499,5 +507,58 @@ def similarproduct_engine() -> Engine:
             "cooccurrence": CooccurrenceAlgorithm,
             "likealgo": LikeAlgorithm,
         },
+        SimilarProductServing,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Recommended-user variant: similar USERS for a set of users
+# (examples/scala-parallel-similarproduct/recommended-user).  The reference
+# reads user-views-USER events and keeps the ALS target-side ("product")
+# factors, which are then viewed-user features — with the datasource's
+# targetEntityType="user", the standard ALSAlgorithm pipeline already
+# computes exactly that; only the query surface differs ({users} in,
+# similar users out).
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class UserQuery:
+    users: tuple[str, ...]
+    num: int = 10
+    white_list: tuple[str, ...] | None = None
+    black_list: tuple[str, ...] | None = None
+
+    params_aliases = {"whiteList": "white_list", "blackList": "black_list"}
+
+
+class RecommendedUserAlgorithm(ALSAlgorithm):
+    """ALSAlgorithm with the user-query surface: the trained "item" table
+    holds viewed-user features (targetEntityType="user"), so similarity,
+    exclusion, white/black lists, persistence, and the positive-score
+    filter are all inherited."""
+
+    query_class = UserQuery
+
+    def predict(
+        self, model: SimilarProductModel, query: UserQuery
+    ) -> PredictedResult:
+        return super().predict(
+            model,
+            Query(
+                items=tuple(query.users),
+                num=query.num,
+                white_list=query.white_list,
+                black_list=query.black_list,
+            ),
+        )
+
+
+@engine_factory("recommendeduser")
+def recommendeduser_engine() -> Engine:
+    return Engine(
+        SimilarProductDataSource,
+        SimilarProductPreparator,
+        {"als": RecommendedUserAlgorithm},
         SimilarProductServing,
     )

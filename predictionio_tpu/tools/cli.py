@@ -354,6 +354,15 @@ _TEMPLATE_VARIANTS = {
              "params": {"rank": 10, "numIterations": 20, "lambda": 0.01}}
         ],
     },
+    "recommendeduser": {
+        "engineFactory": "recommendeduser",
+        "datasource": {"params": {"appName": "MyApp", "eventNames": ["view"],
+                                  "targetEntityType": "user"}},
+        "algorithms": [
+            {"name": "als",
+             "params": {"rank": 10, "numIterations": 20, "lambda": 0.01}}
+        ],
+    },
     "classification": {
         "engineFactory": "classification",
         "datasource": {"params": {"appName": "MyApp"}},

@@ -29,12 +29,16 @@ def _set_event(etype, eid, props=None):
 
 
 def _interaction(event, user, item, props=None):
+    return _interaction_t(event, user, "item", item, props)
+
+
+def _interaction_t(event, user, target_type, target_id, props=None):
     return Event(
         event=event,
         entity_type="user",
         entity_id=user,
-        target_entity_type="item",
-        target_entity_id=item,
+        target_entity_type=target_type,
+        target_entity_id=target_id,
         properties=DataMap(props or {}),
     )
 
@@ -395,3 +399,85 @@ class TestLikeAlgorithm:
         # i1 (liked by all) must outrank i2 (disliked by all, latest event)
         assert "i1" in items
         assert "i2" not in items[:1]
+
+
+class TestRecommendedUser:
+    """recommended-user variant: similar USERS for a set of users, trained
+    on user-views-USER events with the target-side factors as viewed-user
+    features (examples/scala-parallel-similarproduct/recommended-user)."""
+
+    @pytest.fixture()
+    def social_app(self, storage):
+        d = cmd.app_new(storage, "social")
+        events = [_set_event("user", f"u{u}") for u in range(12)]
+        # two communities: users 0-5 view each other, users 6-11 likewise
+        for u in range(12):
+            lo = 0 if u < 6 else 6
+            for v in range(lo, lo + 6):
+                if v != u:
+                    events.append(
+                        _interaction_t("view", f"u{u}", "user", f"u{v}")
+                    )
+        _insert(storage, d.app.id, events)
+        return storage
+
+    def _train(self, storage):
+        from predictionio_tpu.models.similarproduct import recommendeduser_engine
+
+        engine = recommendeduser_engine()
+        params = engine.params_from_json(
+            {
+                "datasource": {"params": {"appName": "social",
+                                          "targetEntityType": "user"}},
+                "algorithms": [
+                    {"name": "als",
+                     "params": {"rank": 6, "numIterations": 10}}
+                ],
+            }
+        )
+        ctx = EngineContext(storage=storage)
+        _, _, algos, _ = engine.instantiate(params)
+        models = engine.train(ctx, params)
+        return algos[0], models[0]
+
+    def test_similar_users_from_same_community(self, social_app):
+        from predictionio_tpu.models.similarproduct import UserQuery
+
+        algo, model = self._train(social_app)
+        result = algo.predict(model, UserQuery(users=("u0",), num=4))
+        assert result.item_scores
+        top = {s.item for s in result.item_scores[:3]}
+        assert top <= {f"u{n}" for n in range(1, 6)}, top
+        # query user never recommended back
+        assert "u0" not in {s.item for s in result.item_scores}
+        # only positive similarities are returned (reference score>0 filter)
+        assert all(s.score > 0 for s in result.item_scores)
+
+    def test_black_and_white_lists(self, social_app):
+        from predictionio_tpu.models.similarproduct import UserQuery
+
+        algo, model = self._train(social_app)
+        r = algo.predict(
+            model, UserQuery(users=("u0",), num=6, black_list=("u1", "u2"))
+        )
+        assert {"u1", "u2"}.isdisjoint({s.item for s in r.item_scores})
+        r = algo.predict(
+            model, UserQuery(users=("u0",), num=6, white_list=("u3", "u4"))
+        )
+        assert {s.item for s in r.item_scores} <= {"u3", "u4"}
+
+    def test_unknown_users_empty(self, social_app):
+        from predictionio_tpu.models.similarproduct import UserQuery
+
+        algo, model = self._train(social_app)
+        assert algo.predict(model, UserQuery(users=("nope",))).item_scores == ()
+
+    def test_persistence_roundtrip(self, social_app):
+        from predictionio_tpu.models.similarproduct import UserQuery
+
+        algo, model = self._train(social_app)
+        data = algo.make_persistent_model(None, model)
+        loaded = algo.load_persistent_model(None, data)
+        a = algo.predict(model, UserQuery(users=("u7",), num=3))
+        b = algo.predict(loaded, UserQuery(users=("u7",), num=3))
+        assert [s.item for s in a.item_scores] == [s.item for s in b.item_scores]
