@@ -47,6 +47,20 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def device_sync(x) -> None:
+    """Force TRUE completion of all queued device work reaching ``x``.
+
+    ``jax.block_until_ready`` can return early through this dev box's
+    device tunnel (observed: block at 4.7s, real completion 114s), so every
+    timed section ends with a tiny dependent device->host transfer instead —
+    the single-device queue executes in order, so one leaf's value arriving
+    proves everything before it ran."""
+    import jax
+
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    np.asarray(leaf[:1] if getattr(leaf, "ndim", 0) else leaf)
+
+
 def make_movielens_like(
     nnz: int,
     num_users: int,
@@ -483,20 +497,34 @@ def main() -> None:
 
     # Warmup: compile + one epoch (epoch cost tracked on stderr).
     t0 = time.perf_counter()
-    train_als(
-        tr_u, tr_i, tr_r, num_users, num_items,
-        params=ALSParams(rank=10, reg=0.01, seed=3, num_iterations=1),
-        mesh=mesh,
+    device_sync(
+        train_als(
+            tr_u, tr_i, tr_r, num_users, num_items,
+            params=ALSParams(rank=10, reg=0.01, seed=3, num_iterations=1),
+            mesh=mesh,
+        ).user_factors
     )
     warm_s = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    state = train_als(
-        tr_u, tr_i, tr_r, num_users, num_items, params=params, mesh=mesh
-    )
-    train_s = time.perf_counter() - t0
+    # best of 2 timed trains: this box's effective scatter throughput swings
+    # 3-4x with co-tenant load (same code, same data measured 1.4s/iter and
+    # 4.8s/iter an hour apart); the minimum reflects the framework
+    train_runs = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        state = train_als(
+            tr_u, tr_i, tr_r, num_users, num_items, params=params, mesh=mesh
+        )
+        device_sync(state.user_factors)
+        train_runs.append(time.perf_counter() - t0)
+    train_s = min(train_runs)
     assert np.isfinite(np.asarray(state.user_factors)).all()
-    log(f"# warmup(compile+1ep)={warm_s:.2f}s train(20 iter)={train_s:.2f}s")
+    log(
+        f"# warmup(compile+1ep)={warm_s:.2f}s "
+        f"train(20 iter)={train_s:.2f}s (runs: "
+        + ", ".join(f"{t:.2f}" for t in train_runs)
+        + ")"
+    )
 
     # Distribution-robustness probe: the same kernel on uniformly-sampled
     # data of identical shape (compile cache hit).  The flat-row scatter
@@ -506,10 +534,12 @@ def main() -> None:
     uu = rng_u.integers(0, num_users, len(tr_u)).astype(np.int64)
     ui = rng_u.integers(0, num_items, len(tr_u)).astype(np.int64)
     t0 = time.perf_counter()
-    train_als(
-        uu, ui, tr_r, num_users, num_items,
-        params=ALSParams(rank=10, reg=0.01, seed=3, num_iterations=2),
-        mesh=mesh,
+    device_sync(
+        train_als(
+            uu, ui, tr_r, num_users, num_items,
+            params=ALSParams(rank=10, reg=0.01, seed=3, num_iterations=2),
+            mesh=mesh,
+        ).user_factors
     )
     ep_uniform = (time.perf_counter() - t0) / 2
     log(
@@ -535,6 +565,7 @@ def main() -> None:
         ),
         mesh=mesh,
     )
+    device_sync(imp.user_factors)
     imp_train_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     map10, prec10, n_eval = compute_ranking_metrics(
@@ -562,9 +593,11 @@ def main() -> None:
     ncf_u = tr_u[pos_mask].astype(np.int32)
     ncf_i = tr_i[pos_mask].astype(np.int32)
     t0 = time.perf_counter()
-    train_ncf(ncf_u, ncf_i, num_users, num_items,
-              params=NCFParams(embed_dim=32, batch_size=8192, seed=3,
-                               num_epochs=1), mesh=mesh)
+    device_sync(
+        train_ncf(ncf_u, ncf_i, num_users, num_items,
+                  params=NCFParams(embed_dim=32, batch_size=8192, seed=3,
+                                   num_epochs=1), mesh=mesh).params["out_b"]
+    )
     ncf_warm_s = time.perf_counter() - t0
     ncf_epochs = 3
     t0 = time.perf_counter()
@@ -572,6 +605,7 @@ def main() -> None:
         ncf_u, ncf_i, num_users, num_items,
         params=NCFParams(embed_dim=32, batch_size=8192, seed=3,
                          num_epochs=ncf_epochs), mesh=mesh)
+    device_sync(ncf_state.params["out_b"])
     ncf_eps = ncf_epochs / (time.perf_counter() - t0)
     log(
         f"# ncf warmup={ncf_warm_s:.1f}s epochs_per_s={ncf_eps:.3f} "
@@ -587,22 +621,21 @@ def main() -> None:
     # this dev box's ~100 ms tunnel round trip out of the measurement, so
     # the per-wave figure approximates what a production TPU-VM serving
     # path pays per wave of 32 queries
-    import jax as _jax
     import jax.numpy as _jnp
 
     waves = [
         _jnp.asarray((np.arange(32) * 131 + w * 37) % num_users, _jnp.int32)
         for w in range(51)
     ]
-    _jax.block_until_ready(
-        _score_topk_batch(ncf_state.params, waves[0], num_items, K)
-    )
+    device_sync(_score_topk_batch(ncf_state.params, waves[0], num_items, K)[0])
     t0 = time.perf_counter()
     outs = [
         _score_topk_batch(ncf_state.params, w, num_items, K)
         for w in waves[1:]
     ]
-    _jax.block_until_ready(outs)
+    # in-order single-device queue: the LAST wave's value arriving proves
+    # all 50 executed (block_until_ready alone can return early here)
+    device_sync(outs[-1][0])
     ncf_wave32_ms = (time.perf_counter() - t0) / 50 * 1000
     log(
         f"# ncf serving_p50_solo={ncf_p50:.3f}ms (incl. dev-tunnel dispatch "
