@@ -129,6 +129,22 @@ def _segment_stats(
     return acc
 
 
+def confidence_weights(rating, valid, implicit_prefs: bool, alpha: float, dtype):
+    """(A-weight, rhs) per COO row — the ONE home of the MLlib semantics.
+
+    Explicit: plain least squares (weight = valid, rhs = r).  Implicit
+    (Hu-Koren / trainImplicit): confidence from |r|, preference = 1 iff
+    r > 0 — negative ratings are high-confidence negatives (the
+    similarproduct LikeAlgorithm dislike path).  Shared by the scatter
+    (_half_step) and pallas (als_pallas.segment_stats_pallas) paths so the
+    two backends cannot drift."""
+    if implicit_prefs:
+        conf_minus_1 = alpha * jnp.abs(rating) * valid
+        pref = (rating > 0).astype(dtype)
+        return conf_minus_1, (1.0 + conf_minus_1) * pref * valid  # c * p
+    return valid, rating * valid
+
+
 def _solve_factors(A, b, counts, reg, scale_reg, gram=None):
     """Solve (A + reg' I [+ gram]) x = b batched over the leading axis."""
     k = b.shape[-1]
@@ -153,21 +169,11 @@ def _half_step(
     axis: str | None,
 ):
     """One alternating update: recompute factors for ``seg`` entities."""
-    dtype = other_factors.dtype
-    if p.implicit_prefs:
-        # MLlib trainImplicit semantics: confidence from |r|, preference
-        # p = 1 iff r > 0 — negative ratings are high-confidence negatives
-        # (the similarproduct LikeAlgorithm dislike path).
-        conf_minus_1 = p.alpha * jnp.abs(rating) * valid
-        a_weight = conf_minus_1  # Vu^T diag(c-1) Vu part
-        pref = (rating > 0).astype(dtype)
-        rhs = (1.0 + conf_minus_1) * pref * valid  # c * p
-        # other_factors is replicated, so the Gram needs no collective.
-        gram = other_factors.T @ other_factors
-    else:
-        a_weight = valid
-        rhs = rating * valid
-        gram = None
+    a_weight, rhs = confidence_weights(
+        rating, valid, p.implicit_prefs, p.alpha, other_factors.dtype
+    )
+    # other_factors is replicated, so the Gram needs no collective.
+    gram = other_factors.T @ other_factors if p.implicit_prefs else None
     acc = _segment_stats(
         seg_idx, other_idx, other_factors, a_weight, rhs, valid,
         num_seg_pad, p.chunk_size, axis,
@@ -196,6 +202,107 @@ def _half_step(
 #: long-lived retraining server on growing data can't pin dead executables.
 _STEP_CACHE: dict = {}
 _STEP_CACHE_MAX = 8
+
+
+def _use_pallas(p: "ALSParams") -> bool:
+    """Single-device TPU runs route the normal-equation accumulation through
+    the scatter-free pallas MXU kernel (ops/als_pallas.py) when the flat row
+    fits its 128-lane width; PIO_ALS_NO_PALLAS=1 forces the scatter path."""
+    import os
+
+    if os.environ.get("PIO_ALS_NO_PALLAS"):
+        return False
+    if p.rank * p.rank + p.rank + 1 > 128:
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _make_pallas_step(key_shapes, p: ALSParams, num_users_pad, num_items_pad):
+    """Jitted one-iteration fn over pre-planned (sorted+padded) streams."""
+    key = ("pallas", key_shapes, num_users_pad, num_items_pad, p.rank, p.reg,
+           p.implicit_prefs, p.alpha, p.scale_reg_with_count)
+    cached = _STEP_CACHE.get(key)
+    if cached is not None:
+        return cached
+    while len(_STEP_CACHE) >= _STEP_CACHE_MAX:
+        del _STEP_CACHE[next(iter(_STEP_CACHE))]
+    from predictionio_tpu.ops import als_pallas
+
+    (tpcu, nbu, tpci, nbi) = key_shapes
+    k = p.rank
+
+    def half(plan_args, oth, rat, val, other_factors, tpc, n_blocks,
+             num_seg_pad):
+        acc = als_pallas.segment_stats_pallas(
+            plan_args, oth, rat, val, other_factors,
+            p.implicit_prefs, p.alpha, tpc, n_blocks,
+        )[:num_seg_pad]
+        A = acc[:, : k * k].reshape(-1, k, k)
+        b = acc[:, k * k : k * k + k]
+        counts = acc[:, k * k + k]
+        gram = (
+            other_factors.T @ other_factors if p.implicit_prefs else None
+        )
+        return _solve_factors(A, b, counts, p.reg, p.scale_reg_with_count, gram)
+
+    @jax.jit
+    def step(u_plan, u_oth, u_rat, u_val,
+             i_plan, i_oth, i_rat, i_val, U, V):
+        U = half(u_plan, u_oth, u_rat, u_val, V, tpcu, nbu, num_users_pad)
+        V = half(i_plan, i_oth, i_rat, i_val, U, tpci, nbi, num_items_pad)
+        return U, V
+
+    _STEP_CACHE[key] = step
+    return step
+
+
+def _train_pallas(user_idx, item_idx, rating, num_users, num_items,
+                  p: ALSParams, dtype) -> "ALSState":
+    """Single-device TPU train via the scatter-free pallas accumulator."""
+    from predictionio_tpu.ops import als_pallas
+
+    num_users_pad = max((num_users + 127) // 128 * 128, 128)
+    num_items_pad = max((num_items + 127) // 128 * 128, 128)
+
+    def stage(seg, oth, num_seg_pad):
+        plan = als_pallas.chunk_plan(
+            als_pallas.build_plan(np.asarray(seg, np.int64), num_seg_pad)
+        )
+        rows = plan.n_chunks * plan.tiles_per_chunk * als_pallas.T
+        oth_p = np.asarray(oth, np.int32)[plan.dest_perm]
+        rat_p = np.asarray(rating, np.float32)[plan.dest_perm]
+        val_p = np.ones(rows, np.float32)
+        oth_p[plan.pad_mask] = 0
+        rat_p[plan.pad_mask] = 0.0
+        val_p[plan.pad_mask] = 0.0
+        shape2 = (plan.n_chunks, plan.tiles_per_chunk * als_pallas.T)
+        plan_args = (
+            jnp.asarray(plan.block_map),
+            jnp.asarray(plan.first),
+            jnp.asarray(plan.seg3),
+            jnp.asarray(plan.visited),
+        )
+        return (plan, plan_args, jnp.asarray(oth_p.reshape(shape2)),
+                jnp.asarray(rat_p.reshape(shape2)),
+                jnp.asarray(val_p.reshape(shape2)))
+
+    up, u_plan, u_oth, u_rat, u_val = stage(user_idx, item_idx, num_users_pad)
+    ip, i_plan, i_oth, i_rat, i_val = stage(item_idx, user_idx, num_items_pad)
+
+    U, V = _init_factors(p, num_users_pad, num_items_pad, num_users,
+                         num_items, dtype)
+    step = _make_pallas_step(
+        (up.tiles_per_chunk, up.n_blocks, ip.tiles_per_chunk, ip.n_blocks),
+        p, num_users_pad, num_items_pad,
+    )
+    for _ in range(p.num_iterations):
+        U, V = step(u_plan, u_oth, u_rat, u_val,
+                    i_plan, i_oth, i_rat, i_val, U, V)
+    jax.block_until_ready((U, V))
+    return ALSState(user_factors=U[:num_users], item_factors=V[:num_items])
 
 
 def _make_train_step(mesh: Mesh | None, num_users_pad, num_items_pad, p: ALSParams):
@@ -315,6 +422,11 @@ def train_als(
     Returns device arrays (callers device_get for persistence).
     """
     p = params or ALSParams()
+    # the pallas accumulator is f32-only; other dtypes keep the scatter path
+    if mesh is None and dtype == jnp.float32 and _use_pallas(p):
+        return _train_pallas(
+            user_idx, item_idx, rating, num_users, num_items, p, dtype
+        )
     n_dev = mesh.devices.size if mesh is not None else 1
     lane = 8 * n_dev  # keep slices sublane-aligned and evenly divisible
     num_users_pad = max(math.ceil(num_users / lane) * lane, lane)
