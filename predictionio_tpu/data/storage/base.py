@@ -235,6 +235,17 @@ class Models(abc.ABC):
     def insert_parts(
         self, instance_id: str, manifest: bytes, parts: Mapping[str, bytes]
     ) -> None:
+        # Instance ids are write-once in normal operation (run_train mints a
+        # fresh id per training run).  Re-saving an existing id is still made
+        # safe: drop the old manifest FIRST so concurrent readers see
+        # "absent" rather than pairing the old part list with new bytes,
+        # then remove the old parts so a re-save with fewer parts cannot
+        # leak orphaned blobs.
+        old = self.get(f"{instance_id}:manifest")
+        if old is not None:
+            self.delete(f"{instance_id}:manifest")
+            for name in _manifest_part_names(old):
+                self.delete(f"{instance_id}:part:{name}")
         for name, blob in parts.items():
             self.insert(f"{instance_id}:part:{name}", blob)
         # manifest last: readers treat its presence as "all parts written"

@@ -78,10 +78,22 @@ class S3Models(base.Models):
         head = getattr(self.client, "head_object", None)
         if head is None:  # minimal injected clients: fall back to get
             try:
-                self.client.get_object(Bucket=self.bucket, Key=key)
+                # ranged get: answer existence without downloading the blob
+                self.client.get_object(
+                    Bucket=self.bucket, Key=key, Range="bytes=0-0"
+                )
                 return True
             except self._missing:
                 return False
+            except Exception as e:
+                # zero-byte objects answer a ranged GET with 416
+                # InvalidRange — the key exists
+                status = (
+                    getattr(e, "response", None) or {}
+                ).get("ResponseMetadata", {}).get("HTTPStatusCode")
+                if status == 416:
+                    return True
+                raise
         try:
             head(Bucket=self.bucket, Key=key)
             return True

@@ -57,6 +57,14 @@ def build_plan(seg: np.ndarray, num_seg_pad: int) -> SegmentPlan:
     """Sort by segment + block-pad; ~3% extra rows at ML-20M shapes."""
     if num_seg_pad % S != 0:
         raise ValueError(f"num_seg_pad must be a multiple of {S}")
+    if len(seg) and (int(seg.min()) < 0 or int(seg.max()) >= num_seg_pad):
+        # the scatter path this replaces dropped out-of-range ids via
+        # .at[].add(mode="drop"); here they would index past the output
+        # buffer through block_map — fail loudly instead of corrupting
+        raise ValueError(
+            f"segment ids must be in [0, {num_seg_pad}); got "
+            f"[{int(seg.min())}, {int(seg.max())}]"
+        )
     order = np.argsort(seg, kind="stable")
     seg_sorted = seg[order]
     n_blocks = num_seg_pad // S

@@ -252,12 +252,14 @@ class AsyncAppServer:
             loop.call_later(0.3, _cancel_all)  # a beat to flush (/stop ack)
 
         loop.call_soon_threadsafe(_stop)
+        # close the micro-batcher BEFORE the loop dies: queued submits get
+        # failed while their futures can still be delivered (handlers answer
+        # 500 instead of hanging), and the worker thread is released so
+        # repeated deploy/shutdown cycles don't accumulate idle executors
+        batcher = getattr(self.app, "microbatcher", None)
+        if batcher is not None:
+            batcher.close()
         if self._thread is not None:
             self._thread.join(timeout=5)
         else:
             self._stopped.wait(timeout=5)
-        # release the app's micro-batch worker thread (if any) so repeated
-        # deploy/shutdown cycles don't accumulate idle executors
-        batcher = getattr(self.app, "microbatcher", None)
-        if batcher is not None:
-            batcher.close()

@@ -44,6 +44,7 @@ class MicroBatcher:
         self._pending: deque[tuple[Any, asyncio.Future]] = deque()
         self._lock = threading.Lock()
         self._dispatching = False
+        self._closed = False
         self._executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="microbatch"
         )
@@ -54,16 +55,38 @@ class MicroBatcher:
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         with self._lock:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
             self._pending.append((item, fut))
-            should_dispatch = not self._dispatching
-            if should_dispatch:
+            # dispatch under the lock: close() sets _closed under the same
+            # lock before shutting the executor down, so a submit that
+            # passed the check above cannot hit a dead executor
+            if not self._dispatching:
                 self._dispatching = True
-        if should_dispatch:
-            loop.run_in_executor(self._executor, self._drain, loop)
+                loop.run_in_executor(self._executor, self._drain, loop)
         return await fut
 
     def close(self) -> None:
-        self._executor.shutdown(wait=False)
+        """Stop accepting work, fail anything still queued, and wait for the
+        in-flight wave — otherwise queued submit() futures would hang until
+        client timeout and late submits would hit a dead executor."""
+        with self._lock:
+            self._closed = True
+            dropped = list(self._pending)
+            self._pending.clear()
+        err = RuntimeError("MicroBatcher closed during shutdown")
+        try:
+            for _, fut in dropped:
+                try:
+                    fut.get_loop().call_soon_threadsafe(
+                        _fail_if_pending, fut, err
+                    )
+                except RuntimeError:
+                    # the futures' loop is already closed (server tore the
+                    # loop down first) — nothing can await them anymore
+                    pass
+        finally:
+            self._executor.shutdown(wait=True)
 
     def _drain(self, loop: asyncio.AbstractEventLoop) -> None:
         """Worker-thread loop: keep dispatching waves until the queue is
@@ -98,6 +121,11 @@ class MicroBatcher:
                 loop.call_soon_threadsafe(
                     _resolve_wave, [f for _, f in wave], None, e
                 )
+
+
+def _fail_if_pending(fut: asyncio.Future, err: BaseException) -> None:
+    if not fut.done():
+        fut.set_exception(err)
 
 
 def _resolve_wave(futures, results, error) -> None:

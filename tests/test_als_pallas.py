@@ -31,6 +31,15 @@ def test_plan_covers_every_row_once():
     assert plan.first.sum() == plan.n_blocks
 
 
+def test_out_of_range_segment_rejected():
+    # the scatter path dropped bad ids; the pallas path must fail loudly
+    # rather than index past the output buffer (silent corruption)
+    with pytest.raises(ValueError, match="segment ids"):
+        ap.build_plan(np.array([0, 5, 384]), 384)
+    with pytest.raises(ValueError, match="segment ids"):
+        ap.build_plan(np.array([-1, 5]), 384)
+
+
 def test_interpret_matches_numpy_add_at():
     rng = np.random.default_rng(1)
     n, nseg = 5000, 256

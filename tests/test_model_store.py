@@ -105,6 +105,20 @@ class TestMultipartStore:
         [out] = load_models(store, "legacy")
         np.testing.assert_array_equal(out["w"], np.arange(3.0))
 
+    def test_overwrite_removes_stale_parts(self, tmp_path):
+        store = LocalFSModels(tmp_path)
+        store.insert_parts(
+            "inst1", b"m1", {"a": b"1", "b": b"2", "c": b"3"}
+        )
+        # re-save with fewer parts: the old ones must not leak
+        store.insert_parts("inst1", b"m2", {"a": b"9"})
+        assert store.get_manifest("inst1") == b"m2"
+        assert store.get_part("inst1", "a") == b"9"
+        assert store.get_part("inst1", "b") is None
+        assert store.get_part("inst1", "c") is None
+        assert store.delete_models("inst1")
+        assert list(tmp_path.glob("pio_model_inst1*")) == []
+
     def test_delete_models_removes_both_layouts(self, tmp_path):
         store = LocalFSModels(tmp_path)
         save_models(store, "inst1", [make_model()])
@@ -129,11 +143,15 @@ class FakeS3Client:
     def put_object(self, Bucket, Key, Body):
         self.objects[f"{Bucket}/{Key}"] = bytes(Body)
 
-    def get_object(self, Bucket, Key):
+    def get_object(self, Bucket, Key, Range=None):
         k = f"{Bucket}/{Key}"
         if k not in self.objects:
             raise self.exceptions.NoSuchKey(k)
-        return {"Body": self.objects[k]}
+        body = self.objects[k]
+        if Range:  # "bytes=a-b" — existence probes use bytes=0-0
+            a, b = Range.removeprefix("bytes=").split("-")
+            body = body[int(a) : int(b) + 1]
+        return {"Body": body}
 
     def delete_object(self, Bucket, Key):
         self.objects.pop(f"{Bucket}/{Key}", None)

@@ -104,6 +104,35 @@ class TestMicroBatcher:
 
         asyncio.run(run())
 
+    def test_close_fails_queued_and_rejects_new_submits(self):
+        release = threading.Event()
+
+        def batch_fn(items):
+            release.wait(2)  # hold wave 1 so later submits stay queued
+            return list(items)
+
+        async def run():
+            b = MicroBatcher(batch_fn, max_batch=1)
+            first = asyncio.ensure_future(b.submit(1))
+            await asyncio.sleep(0.05)  # wave 1 in flight (held on `release`)
+            queued = asyncio.ensure_future(b.submit(2))
+            await asyncio.sleep(0.05)  # queued behind the held wave
+            # close while wave 1 is still held: it must drop the queued
+            # item, then block in shutdown(wait=True) until wave 1 ends
+            close_task = asyncio.get_running_loop().run_in_executor(
+                None, b.close
+            )
+            await asyncio.sleep(0.05)
+            release.set()
+            await close_task
+            assert await first == 1  # in-flight wave still resolves
+            with pytest.raises(RuntimeError, match="closed"):
+                await queued
+            with pytest.raises(RuntimeError, match="closed"):
+                await b.submit(3)
+
+        asyncio.run(run())
+
 
 def _get(url: str):
     with urllib.request.urlopen(url, timeout=5) as r:
