@@ -157,6 +157,34 @@ class ECommPreparator(Preparator):
         return td
 
 
+def latest_rating_per_pair(u, i, ratings, times, n_items: int):
+    """genMLlibRating semantics: latest rating wins per (user, item)
+    (ECommAlgorithm.scala train-with-rate-event genMLlibRating).
+
+    Vectorized group-reduce: lexsort by (pair-key, time) — both sorts
+    stable — then keep each key group's LAST row, which is exactly the
+    entry a sequential "overwrite in time order" loop would retain (time
+    ties resolve to the later event, as dict insertion did).  No per-event
+    Python work, so 20M-event streams reduce in seconds.
+    """
+    if len(u) == 0:
+        return (
+            np.empty(0, np.int32),
+            np.empty(0, np.int32),
+            np.empty(0, np.float32),
+        )
+    key = u.astype(np.int64) * n_items + i
+    order = np.lexsort((times, key))
+    ks = key[order]
+    last = np.flatnonzero(np.r_[ks[1:] != ks[:-1], True])
+    ku = ks[last]
+    return (
+        (ku // n_items).astype(np.int32),
+        (ku % n_items).astype(np.int32),
+        np.asarray(ratings)[order][last].astype(np.float32),
+    )
+
+
 @dataclass(frozen=True)
 class ECommAlgorithmParams:
     app_name: str = "default"
@@ -215,18 +243,17 @@ class ECommAlgorithm(Algorithm):
         )
         if not train_mask.any():
             raise SanityCheckError("no valid training interactions")
-        # genMLlibRating semantics: latest rating wins per (user, item)
-        key = u[train_mask].astype(np.int64) * len(item_vocab) + i[train_mask]
-        order = np.argsort(pd.int_times[train_mask], kind="stable")
-        latest: dict[int, float] = {}
-        rr = pd.int_ratings[train_mask]
-        for o in order:
-            latest[int(key[o])] = float(rr[o])
-        ku = np.fromiter(latest.keys(), np.int64, len(latest))
+        lu, li, lr = latest_rating_per_pair(
+            u[train_mask],
+            i[train_mask],
+            pd.int_ratings[train_mask],
+            pd.int_times[train_mask],
+            len(item_vocab),
+        )
         state = train_als(
-            (ku // len(item_vocab)).astype(np.int32),
-            (ku % len(item_vocab)).astype(np.int32),
-            np.fromiter(latest.values(), np.float32, len(latest)),
+            lu,
+            li,
+            lr,
             num_users=len(user_vocab),
             num_items=len(item_vocab),
             params=ALSParams(

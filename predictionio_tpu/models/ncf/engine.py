@@ -64,10 +64,17 @@ class NCFAlgorithmParams:
 def _score_topk(params, user_idx, n_items: int, k: int):
     """Serving hot path as ONE compiled program: score every item, mask
     table padding rows, top-k (the recommendation template's
-    _topk_for_user pattern)."""
+    _topk_for_user pattern).
+
+    Returns ONE packed [2, k] f32 array (row 0 = scores, row 1 = item
+    indices) instead of a (scores, indices) pair: fetching two separate
+    outputs costs two device->host transfers, and on a remote-tunneled
+    device each transfer is a full round trip — the packed layout halves
+    solo-query latency.  f32 holds item ids exactly up to 2^24."""
     scores = score_all_items(params, user_idx)
     masked = jnp.where(jnp.arange(scores.shape[0]) < n_items, scores, -jnp.inf)
-    return jax.lax.top_k(masked, k)
+    s, i = jax.lax.top_k(masked, k)
+    return jnp.stack([s, i.astype(jnp.float32)])
 
 
 @partial(jax.jit, static_argnames=("n_items", "k"))
@@ -77,13 +84,29 @@ def _score_topk_batch(params, user_idx, n_items: int, k: int):
     One device round trip per wave instead of per query — under
     concurrency the dispatch overhead amortizes B-fold (the reason the
     MicroBatcher exists).  Callers pad ``user_idx`` to a power of two so
-    at most log2(max_batch) variants ever compile.
+    at most log2(max_batch) variants ever compile.  Output is packed
+    [2, B, k] f32 (scores, indices) for the same one-transfer reason as
+    ``_score_topk``.
     """
     scores = jax.vmap(lambda u: score_all_items(params, u))(user_idx)
     masked = jnp.where(
         jnp.arange(scores.shape[1])[None, :] < n_items, scores, -jnp.inf
     )
-    return jax.lax.top_k(masked, k)
+    s, i = jax.lax.top_k(masked, k)
+    return jnp.stack([s, i.astype(jnp.float32)])
+
+
+def _packable_n_items(model: "NCFModel") -> int:
+    """The packed [scores | indices] f32 transfer holds item ids exactly
+    only below 2^24; beyond that the roundtrip would silently return wrong
+    items, so refuse loudly (catalogs that big need an int32 output path)."""
+    n_items = len(model.item_vocab)
+    if n_items >= 1 << 24:
+        raise ValueError(
+            f"{n_items} items exceeds the f32-exact id range of the packed "
+            "top-k transfer (2^24)"
+        )
+    return n_items
 
 
 @dataclass
@@ -140,15 +163,15 @@ class NCFAlgorithm(Algorithm):
         uidx = model.user_vocab.get(query.user)
         if uidx is None:
             return PredictedResult()
-        n_items = len(model.item_vocab)
+        n_items = _packable_n_items(model)
         k = min(query.num, n_items)
-        top_s, top_i = _score_topk(
-            model.state.params, jnp.int32(uidx), n_items, k
+        packed = np.asarray(  # ONE device->host transfer (see _score_topk)
+            _score_topk(model.state.params, jnp.int32(uidx), n_items, k)
         )
         return PredictedResult(
             item_scores=tuple(
                 ItemScore(item=model.item_vocab.inverse(int(i)), score=float(s))
-                for s, i in zip(np.asarray(top_s), np.asarray(top_i))
+                for s, i in zip(packed[0], packed[1].astype(np.int64))
                 if np.isfinite(s)
             )
         )
@@ -171,7 +194,7 @@ class NCFAlgorithm(Algorithm):
     def _predict_wave(self, model: NCFModel, iq):
         if not iq:
             return []
-        n_items = len(model.item_vocab)
+        n_items = _packable_n_items(model)
         uidx = np.array(
             [model.user_vocab.get(q.user, -1) for _, q in iq], np.int32
         )
@@ -183,11 +206,13 @@ class NCFAlgorithm(Algorithm):
         b = max(1 << (len(iq) - 1).bit_length(), 32)
         padded = np.zeros(b, np.int32)
         padded[: len(iq)] = np.maximum(uidx, 0)
-        top_s, top_i = _score_topk_batch(
-            model.state.params, jnp.asarray(padded), n_items, k
+        packed = np.asarray(
+            _score_topk_batch(
+                model.state.params, jnp.asarray(padded), n_items, k
+            )
         )
-        top_s = np.asarray(top_s)
-        top_i = np.asarray(top_i)
+        top_s = packed[0]
+        top_i = packed[1].astype(np.int64)
         out = []
         for row, (i, q) in enumerate(iq):
             if uidx[row] < 0:

@@ -329,25 +329,88 @@ class LikeAlgorithm(ALSAlgorithm):
     preference 0 at confidence 1+alpha, MLlib trainImplicit semantics."""
 
     def _interactions(self, pd: PreparedData):
-        latest: dict[tuple[str, str], tuple[int, float]] = {}
-        for u, i, w, t in zip(
-            pd.view_users, pd.view_items, pd.view_weights, pd.view_times
-        ):
-            key = (u, i)
-            prev = latest.get(key)
-            if prev is None or t >= prev[0]:
-                latest[key] = (int(t), 1.0 if w > 0 else -1.0)
-        if not latest:
+        if len(pd.view_users) == 0:
             return pd.view_users, pd.view_items, pd.view_weights
-        users = np.array([k[0] for k in latest], object)
-        items = np.array([k[1] for k in latest], object)
-        weights = np.array([v[1] for v in latest.values()], np.float32)
-        return users, items, weights
+        # Vectorized latest-per-(user,item): encode both entities to int
+        # codes, lexsort by (pair-key, time) — both stable — and keep each
+        # group's LAST row.  The sequential loop kept the latest time with
+        # later events winning ties (t >= prev[0]); stable sort + last-of-
+        # group reproduces that exactly, with no per-event Python work.
+        _, ucode = np.unique(pd.view_users, return_inverse=True)
+        uniq_items, icode = np.unique(pd.view_items, return_inverse=True)
+        key = ucode.astype(np.int64) * len(uniq_items) + icode
+        order = np.lexsort((np.asarray(pd.view_times), key))
+        ks = key[order]
+        sel = order[np.flatnonzero(np.r_[ks[1:] != ks[:-1], True])]
+        weights = np.where(
+            np.asarray(pd.view_weights)[sel] > 0, 1.0, -1.0
+        ).astype(np.float32)
+        return (
+            np.asarray(pd.view_users)[sel],
+            np.asarray(pd.view_items)[sel],
+            weights,
+        )
 
 
 # ---------------------------------------------------------------------------
 # Co-occurrence
 # ---------------------------------------------------------------------------
+
+
+def _sparse_cooccurrence(pairs: np.ndarray, n_items: int):
+    """Symmetric co-view COO (src, dst, count) via vectorized per-user pair
+    expansion — the reference's self-join semantics
+    (CooccurrenceAlgorithm.scala:84-88) with no per-event Python loop.
+
+    ``pairs`` is the deduped [(user, item)] array lexicographically sorted
+    (np.unique output), so items ascend within each user segment and every
+    generated (left, right) pair already has left < right.  Total work is
+    O(sum deg^2) like the reference's self-join; pair generation is chunked
+    (~32M pairs at a time) so peak memory stays bounded on heavy users.
+    """
+    u = pairs[:, 0].astype(np.int64)
+    it = pairs[:, 1].astype(np.int64)
+    n = len(u)
+    empty = (np.empty(0, np.int64),) * 3
+    if n == 0:
+        return empty
+    seg_starts = np.flatnonzero(np.r_[True, u[1:] != u[:-1]])
+    deg = np.diff(np.r_[seg_starts, n])
+    pos = np.arange(n) - np.repeat(seg_starts, deg)
+    rep = np.repeat(deg, deg) - 1 - pos  # rights paired with each left row
+    cum = np.cumsum(rep)
+    key_parts: list[np.ndarray] = []
+    cnt_parts: list[np.ndarray] = []
+    budget = 1 << 25
+    start = 0
+    while start < n:
+        base = cum[start - 1] if start else 0
+        end = max(int(np.searchsorted(cum, base + budget, "right")), start + 1)
+        # splitting inside a user segment is safe: each LEFT row's pair set
+        # (its rights) is generated wholly within the chunk that owns it
+        r = rep[start:end]
+        tot = int(r.sum())
+        if tot:
+            grp = np.cumsum(r) - r
+            within = np.arange(tot) - np.repeat(grp, r)
+            right_rows = np.repeat(np.arange(start, end) + 1, r) + within
+            k = np.repeat(it[start:end], r) * n_items + it[right_rows]
+            uk, uc = np.unique(k, return_counts=True)
+            key_parts.append(uk)
+            cnt_parts.append(uc.astype(np.int64))
+        start = end
+    if not key_parts:
+        return empty
+    allk = np.concatenate(key_parts)
+    uk, inv = np.unique(allk, return_inverse=True)
+    cc = np.zeros(len(uk), np.int64)
+    np.add.at(cc, inv, np.concatenate(cnt_parts))
+    i1, i2 = uk // n_items, uk % n_items
+    return (
+        np.concatenate([i1, i2]),
+        np.concatenate([i2, i1]),
+        np.concatenate([cc, cc]),
+    )
 
 
 @dataclass(frozen=True)
@@ -397,42 +460,24 @@ class CooccurrenceAlgorithm(Algorithm):
             ].set(1.0)
             counts = np.array(b.T @ b)
             np.fill_diagonal(counts, 0)
-            rows_iter = (
-                (idx, np.nonzero(counts[idx])[0], counts[idx])
-                for idx in range(n_items)
-            )
+            src, dst = np.nonzero(counts)
+            cnt = counts[src, dst].astype(np.int64)
         else:
-            # big catalogs: sparse per-user pair counting, O(sum deg^2) not
-            # O(U*I) — the reference's self-join semantics
-            # (CooccurrenceAlgorithm.scala:84-88)
-            from collections import defaultdict
-
-            by_user: dict[int, list[int]] = defaultdict(list)
-            for uu, ii in pairs:
-                by_user[int(uu)].append(int(ii))
-            pair_counts: dict[tuple[int, int], int] = defaultdict(int)
-            for viewed in by_user.values():
-                viewed.sort()
-                for a in range(len(viewed)):
-                    for b_ in range(a + 1, len(viewed)):
-                        pair_counts[(viewed[a], viewed[b_])] += 1
-            sparse_rows: dict[int, dict[int, int]] = defaultdict(dict)
-            for (i1, i2), c in pair_counts.items():
-                sparse_rows[i1][i2] = c
-                sparse_rows[i2][i1] = c
-            rows_iter = (
-                (idx, np.fromiter(row.keys(), np.int64, len(row)),
-                 row)  # row is a dict: row[j] works below
-                for idx, row in sparse_rows.items()
-            )
+            src, dst, cnt = _sparse_cooccurrence(pairs, n_items)
+        # top-N per source item, fully vectorized: one lexsort orders every
+        # (src asc, count desc, dst asc) triple; each item's slice prefix is
+        # its top-N (dst ascending on ties, matching the old stable argsort)
         top: dict[int, list[tuple[int, int]]] = {}
         n_keep = self.params.n
-        for idx, nz, row in rows_iter:
-            if len(nz) == 0:
-                continue
-            vals = np.array([row[j] for j in nz])
-            order = nz[np.argsort(-vals, kind="stable")][:n_keep]
-            top[idx] = [(int(j), int(row[j])) for j in order]
+        if len(src):
+            order = np.lexsort((dst, -cnt, src))
+            s2, d2, c2 = src[order], dst[order], cnt[order]
+            starts = np.flatnonzero(np.r_[True, s2[1:] != s2[:-1]])
+            ends = np.r_[starts[1:], len(s2)]
+            for st, en in zip(starts, np.minimum(ends, starts + n_keep)):
+                top[int(s2[st])] = [
+                    (int(j), int(c)) for j, c in zip(d2[st:en], c2[st:en])
+                ]
         return CooccurrenceModel(
             top_cooccurrences=top, item_vocab=item_vocab, items=dict(pd.items)
         )

@@ -40,7 +40,7 @@ def test_out_of_range_segment_rejected():
         ap.build_plan(np.array([-1, 5]), 384)
 
 
-def test_interpret_matches_numpy_add_at():
+def _accum_vs_numpy(precision):
     rng = np.random.default_rng(1)
     n, nseg = 5000, 256
     seg = rng.integers(0, 200, n)
@@ -48,7 +48,9 @@ def test_interpret_matches_numpy_add_at():
     upd = rng.standard_normal((n, ap.W)).astype(np.float32)
     updp = upd[plan.dest_perm]
     updp[plan.pad_mask] = 0
-    acc = ap.make_segment_accum(plan.n_tiles, plan.n_blocks, interpret=True)(
+    acc = ap.make_segment_accum(
+        plan.n_tiles, plan.n_blocks, precision=precision, interpret=True
+    )(
         jnp.asarray(plan.block_map),
         jnp.asarray(plan.first),
         jnp.asarray(plan.seg3),
@@ -56,9 +58,31 @@ def test_interpret_matches_numpy_add_at():
     )
     ref = np.zeros((nseg, ap.W), np.float32)
     np.add.at(ref, seg, upd)
-    np.testing.assert_allclose(
-        np.asarray(acc)[:nseg], ref, rtol=2e-5, atol=2e-5
-    )
+    return np.asarray(acc)[:nseg], ref
+
+
+def test_interpret_matches_numpy_add_at():
+    acc, ref = _accum_vs_numpy("highest")
+    np.testing.assert_allclose(acc, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_hilo_precision_near_f32():
+    # 2-pass Dekker split: ~2^-16 relative — the training default
+    acc, ref = _accum_vs_numpy("hilo")
+    np.testing.assert_allclose(acc, ref, rtol=2e-4, atol=2e-3)
+
+
+def test_bf16_precision_coarse():
+    # single pass: ~2^-8 relative
+    acc, ref = _accum_vs_numpy("bf16")
+    err = np.abs(acc - ref) / (np.abs(ref) + 1.0)
+    assert err.max() < 3e-2
+
+
+def test_row_width():
+    assert ap.row_width(10) == 128
+    assert ap.row_width(11) == 256
+    assert ap.row_width(32) == 1152
 
 
 def test_segment_stats_matches_scatter_semantics():

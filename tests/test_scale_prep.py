@@ -1,0 +1,181 @@
+"""Template train-prep at scale: the host-side group-reduces must handle
+1M+ events in seconds with NO per-event Python loop, and must match the
+sequential (dict-loop) reference semantics exactly.
+
+VERDICT r3 item 5: ecommerce latest-rating, similarproduct LikeAlgorithm
+latest-event, and the cooccurrence sparse self-join were per-event Python
+loops that would not survive ML-20M-scale data.  Each test here checks the
+vectorized replacement against a brute-force oracle on small random data,
+then pushes >=1M synthetic events through it under a generous wall-clock
+bound (the old loops took minutes; the vectorized paths take ~1-2 s).
+"""
+
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models.ecommerce.engine import latest_rating_per_pair
+from predictionio_tpu.models.similarproduct.engine import (
+    LikeAlgorithm,
+    _sparse_cooccurrence,
+)
+
+SCALE = 1_200_000
+TIME_BUDGET_S = 30.0  # generous for CI; observed ~1-2 s
+
+
+class TestLatestRatingPerPair:
+    def _oracle(self, u, i, r, t, n_items):
+        latest = {}
+        order = np.argsort(t, kind="stable")
+        for o in order:
+            latest[(int(u[o]), int(i[o]))] = float(r[o])
+        return {k: v for k, v in sorted(latest.items())}
+
+    def test_matches_sequential_overwrite(self):
+        rng = np.random.default_rng(0)
+        n = 5000
+        u = rng.integers(0, 40, n).astype(np.int64)
+        i = rng.integers(0, 30, n).astype(np.int64)
+        r = rng.integers(1, 6, n).astype(np.float32)
+        # coarse times force plenty of ties — the tie-break (later event
+        # wins) is the subtle part
+        t = rng.integers(0, 50, n).astype(np.int64)
+        lu, li, lr = latest_rating_per_pair(u, i, r, t, 30)
+        got = {(int(a), int(b)): float(c) for a, b, c in zip(lu, li, lr)}
+        assert got == self._oracle(u, i, r, t, 30)
+
+    def test_empty(self):
+        lu, li, lr = latest_rating_per_pair(
+            np.empty(0, np.int64), np.empty(0, np.int64),
+            np.empty(0, np.float32), np.empty(0, np.int64), 10,
+        )
+        assert len(lu) == len(li) == len(lr) == 0
+
+    def test_million_events_in_seconds(self):
+        rng = np.random.default_rng(1)
+        u = rng.integers(0, 50_000, SCALE)
+        i = rng.integers(0, 20_000, SCALE)
+        r = rng.integers(1, 6, SCALE).astype(np.float32)
+        t = rng.integers(0, 10**9, SCALE)
+        t0 = time.perf_counter()
+        lu, li, lr = latest_rating_per_pair(u, i, r, t, 20_000)
+        took = time.perf_counter() - t0
+        assert took < TIME_BUDGET_S, f"prep took {took:.1f}s"
+        assert len(lu) == len(np.unique(u * 20_000 + i))
+
+
+class TestLikeInteractions:
+    def _pd(self, users, items, weights, times):
+        return SimpleNamespace(
+            view_users=np.asarray(users, object),
+            view_items=np.asarray(items, object),
+            view_weights=np.asarray(weights, np.float32),
+            view_times=np.asarray(times, np.int64),
+        )
+
+    def _oracle(self, pd):
+        latest = {}
+        for u, i, w, t in zip(
+            pd.view_users, pd.view_items, pd.view_weights, pd.view_times
+        ):
+            prev = latest.get((u, i))
+            if prev is None or t >= prev[0]:
+                latest[(u, i)] = (int(t), 1.0 if w > 0 else -1.0)
+        return {k: v[1] for k, v in latest.items()}
+
+    def test_matches_sequential_latest_wins(self):
+        rng = np.random.default_rng(2)
+        n = 4000
+        users = [f"u{x}" for x in rng.integers(0, 50, n)]
+        items = [f"i{x}" for x in rng.integers(0, 40, n)]
+        weights = rng.choice([1.0, -1.0], n)
+        times = rng.integers(0, 60, n)  # heavy ties
+        pd = self._pd(users, items, weights, times)
+        uu, ii, ww = LikeAlgorithm.__new__(LikeAlgorithm)._interactions(pd)
+        got = {(u, i): float(w) for u, i, w in zip(uu, ii, ww)}
+        assert got == self._oracle(pd)
+
+    def test_million_events_in_seconds(self):
+        rng = np.random.default_rng(3)
+        users = np.array([f"u{x}" for x in range(60_000)], object)[
+            rng.integers(0, 60_000, SCALE)
+        ]
+        items = np.array([f"i{x}" for x in range(20_000)], object)[
+            rng.integers(0, 20_000, SCALE)
+        ]
+        pd = self._pd(
+            users, items,
+            rng.choice([1.0, -1.0], SCALE), rng.integers(0, 10**9, SCALE),
+        )
+        t0 = time.perf_counter()
+        uu, ii, ww = LikeAlgorithm.__new__(LikeAlgorithm)._interactions(pd)
+        took = time.perf_counter() - t0
+        assert took < TIME_BUDGET_S, f"prep took {took:.1f}s"
+        assert set(np.unique(ww)) <= {1.0, -1.0}
+
+
+class TestSparseCooccurrence:
+    def _oracle(self, pairs):
+        from collections import defaultdict
+
+        by_user = defaultdict(list)
+        for uu, ii in pairs:
+            by_user[int(uu)].append(int(ii))
+        counts = defaultdict(int)
+        for viewed in by_user.values():
+            viewed.sort()
+            for a in range(len(viewed)):
+                for b in range(a + 1, len(viewed)):
+                    counts[(viewed[a], viewed[b])] += 1
+        return dict(counts)
+
+    def test_matches_self_join(self):
+        rng = np.random.default_rng(4)
+        u = rng.integers(0, 30, 2000)
+        i = rng.integers(0, 25, 2000)
+        pairs = np.unique(np.stack([u, i], axis=1), axis=0)
+        src, dst, cnt = _sparse_cooccurrence(pairs, 25)
+        got = {
+            (int(a), int(b)): int(c)
+            for a, b, c in zip(src, dst, cnt)
+            if a < b
+        }
+        assert got == self._oracle(pairs)
+        # symmetric expansion present
+        sym = {(int(b), int(a)): int(c) for a, b, c in zip(src, dst, cnt) if a < b}
+        assert all(
+            dict(zip(zip(src.tolist(), dst.tolist()), cnt.tolist()))[k] == v
+            for k, v in sym.items()
+        )
+
+    def test_chunk_boundary_inside_user_segment(self):
+        # one heavy user whose pair expansion spans multiple chunks
+        import predictionio_tpu.models.similarproduct.engine as sp
+
+        u = np.zeros(4000, np.int64)
+        i = np.arange(4000, dtype=np.int64)
+        pairs = np.stack([u, i], axis=1)
+        src, dst, cnt = _sparse_cooccurrence(pairs, 4000)
+        # 4000 choose 2 unique pairs, each count 1, expanded symmetric
+        assert len(src) == 2 * (4000 * 3999 // 2)
+        assert (cnt == 1).all()
+
+    def test_empty(self):
+        src, dst, cnt = _sparse_cooccurrence(np.empty((0, 2), np.int64), 10)
+        assert len(src) == 0
+
+    def test_million_pairs_in_seconds(self):
+        rng = np.random.default_rng(5)
+        # ~1.2M deduped view pairs over 200k users / 50k items:
+        # sum(deg^2) ~ 8M generated pairs
+        u = rng.integers(0, 200_000, SCALE)
+        i = rng.integers(0, 50_000, SCALE)
+        pairs = np.unique(np.stack([u, i], axis=1), axis=0)
+        t0 = time.perf_counter()
+        src, dst, cnt = _sparse_cooccurrence(pairs, 50_000)
+        took = time.perf_counter() - t0
+        assert took < TIME_BUDGET_S, f"prep took {took:.1f}s"
+        assert len(src) and (cnt > 0).all()
