@@ -85,6 +85,14 @@ class S3Models(base.Models):
                 return True
             except self._missing:
                 return False
+            except TypeError:
+                # client doesn't accept Range at all: plain get (the
+                # pre-Range behavior, still correct, just heavier)
+                try:
+                    self.client.get_object(Bucket=self.bucket, Key=key)
+                    return True
+                except self._missing:
+                    return False
             except Exception as e:
                 # zero-byte objects answer a ranged GET with 416
                 # InvalidRange — the key exists

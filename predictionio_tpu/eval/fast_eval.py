@@ -11,6 +11,9 @@ A trains instead of N of each.
 from __future__ import annotations
 
 import json
+import os
+import tempfile
+from collections import OrderedDict
 from typing import Any, Sequence
 
 from predictionio_tpu.core.base import EngineContext
@@ -23,6 +26,73 @@ def _key(*parts: Any) -> str:
     return json.dumps(parts, sort_keys=True, default=str)
 
 
+class SpillingModelCache:
+    """Bounded trained-model cache: at most ``max_live`` entries stay in
+    RAM; older entries spill to disk via core.persistence and reload on hit.
+
+    The reference's FastEvalEngine holds lazy Spark handles, so caching every
+    params-prefix is free (FastEvalEngine.scala:46-345).  Here entries are
+    materialized factor/embedding matrices — an unbounded dict OOMs the host
+    on a large sweep at ML-20M scale, so the LRU spills evictions through
+    ``serialize_models`` (device arrays come back as host numpy, which the
+    eval path accepts anywhere a trained model is used).
+    """
+
+    def __init__(self, max_live: int | None = None):
+        if max_live is None:
+            max_live = int(os.environ.get("PIO_FAST_EVAL_MAX_LIVE", "2"))
+        self.max_live = max(max_live, 1)
+        self._live: OrderedDict[str, list] = OrderedDict()
+        self._spilled: dict[str, str] = {}  # key -> file path
+        self._dir: tempfile.TemporaryDirectory | None = None
+        self.reload_count = 0
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._live or key in self._spilled
+
+    def __len__(self) -> int:
+        return len(self._live) + len(self._spilled)
+
+    @property
+    def live_count(self) -> int:
+        return len(self._live)
+
+    def get(self, key: str) -> list:
+        if key in self._live:
+            self._live.move_to_end(key)
+            return self._live[key]
+        from predictionio_tpu.core.persistence import deserialize_models
+
+        path = self._spilled.pop(key)
+        with open(path, "rb") as f:
+            models = deserialize_models(f.read())
+        os.unlink(path)  # a later re-spill rewrites it; never orphan blobs
+        self.reload_count += 1
+        self.put(key, models)
+        return models
+
+    def put(self, key: str, models: list) -> None:
+        self._live[key] = models
+        self._live.move_to_end(key)
+        while len(self._live) > self.max_live:
+            self._spill(*self._live.popitem(last=False))
+
+    def _spill(self, key: str, models: list) -> None:
+        import hashlib
+
+        from predictionio_tpu.core.persistence import serialize_models
+
+        if self._dir is None:
+            self._dir = tempfile.TemporaryDirectory(prefix="pio_fasteval_")
+        # deterministic per-key name: a spill->reload->re-spill cycle
+        # overwrites the same file instead of accumulating orphans
+        digest = hashlib.sha1(key.encode()).hexdigest()[:20]
+        path = os.path.join(self._dir.name, f"spill_{digest}.pkl")
+        with open(path, "wb") as f:
+            f.write(serialize_models(models))
+        self._spilled[key] = path
+
+
 class FastEvalEngine(Engine):
     """Engine whose eval() memoizes datasource/preparator/algorithm prefixes."""
 
@@ -30,7 +100,9 @@ class FastEvalEngine(Engine):
         super().__init__(*args, **kwargs)
         self._ds_cache: dict[str, Any] = {}
         self._prep_cache: dict[str, Any] = {}
-        self._train_cache: dict[str, Any] = {}
+        # trained models: bounded LRU that spills evictions to disk so a
+        # large sweep runs in bounded RSS (see SpillingModelCache)
+        self._train_cache = SpillingModelCache()
         # hit counters exposed for tests (FastEvalEngineTest counts cache use)
         self.counts = {"datasource": 0, "preparator": 0, "train": 0}
 
@@ -74,8 +146,8 @@ class FastEvalEngine(Engine):
             if k not in self._train_cache:
                 self.counts["train"] += 1
                 algo = doer(self.algorithm_classes[name], algo_params)
-                self._train_cache[k] = [algo.train(ctx, pd) for pd in pds]
-            per_algo_models.append(self._train_cache[k])
+                self._train_cache.put(k, [algo.train(ctx, pd) for pd in pds])
+            per_algo_models.append(self._train_cache.get(k))
         return eval_sets, per_algo_models
 
     def eval(self, ctx: EngineContext, params: EngineParams):

@@ -32,9 +32,13 @@ from predictionio_tpu.tools.commands import (
 )
 
 
-def create_admin_app(storage: StorageRuntime | None = None) -> HTTPApp:
+def create_admin_app(
+    storage: StorageRuntime | None = None, access_key: str | None = None
+) -> HTTPApp:
+    """``access_key`` gates every route (the dashboard's KeyAuthentication
+    applied to the admin surface); TLS comes from the AppServer layer."""
     storage = storage or get_storage()
-    app = HTTPApp("adminserver")
+    app = HTTPApp("adminserver", access_key=access_key)
 
     def describe(d: AppDescription) -> dict:
         return d.to_json_dict()
@@ -92,6 +96,17 @@ def create_admin_app(storage: StorageRuntime | None = None) -> HTTPApp:
 
 
 def create_admin_server(
-    host: str = "0.0.0.0", port: int = 7071, storage: StorageRuntime | None = None
+    host: str = "0.0.0.0",
+    port: int = 7071,
+    storage: StorageRuntime | None = None,
+    access_key: str | None = None,
+    ssl_certfile: str | None = None,
+    ssl_keyfile: str | None = None,
 ) -> AppServer:
-    return AppServer(create_admin_app(storage), host, port)
+    return AppServer(
+        create_admin_app(storage, access_key=access_key),
+        host,
+        port,
+        ssl_certfile=ssl_certfile,
+        ssl_keyfile=ssl_keyfile,
+    )

@@ -21,9 +21,13 @@ from predictionio_tpu.server.httpd import (
 )
 
 
-def create_dashboard_app(storage: StorageRuntime | None = None) -> HTTPApp:
+def create_dashboard_app(
+    storage: StorageRuntime | None = None, access_key: str | None = None
+) -> HTTPApp:
+    """``access_key`` gates every route (Dashboard.scala:47 mixes in
+    KeyAuthentication); TLS comes from the AppServer layer below."""
     storage = storage or get_storage()
-    app = HTTPApp("dashboard")
+    app = HTTPApp("dashboard", access_key=access_key)
 
     @app.route("GET", "/")
     def index(req: Request) -> Response:
@@ -71,6 +75,17 @@ def create_dashboard_app(storage: StorageRuntime | None = None) -> HTTPApp:
 
 
 def create_dashboard_server(
-    host: str = "0.0.0.0", port: int = 9000, storage: StorageRuntime | None = None
+    host: str = "0.0.0.0",
+    port: int = 9000,
+    storage: StorageRuntime | None = None,
+    access_key: str | None = None,
+    ssl_certfile: str | None = None,
+    ssl_keyfile: str | None = None,
 ) -> AppServer:
-    return AppServer(create_dashboard_app(storage), host, port)
+    return AppServer(
+        create_dashboard_app(storage, access_key=access_key),
+        host,
+        port,
+        ssl_certfile=ssl_certfile,
+        ssl_keyfile=ssl_keyfile,
+    )

@@ -246,6 +246,23 @@ def create_event_server_app(
             results.append({"status": 201, "eventId": event_id})
         return json_response(200, results)
 
+    # -- plugins (EventServer.scala:154-206) ---------------------------------
+    @app.route("GET", "/plugins\\.json")
+    @authed
+    def list_plugins(req: Request, auth: AuthData) -> Response:
+        return json_response(200, {"plugins": plugins.descriptions()})
+
+    @app.route(
+        "GET",
+        "/plugins/(?P<ptype>[^/]+)/(?P<pname>[^/]+)(?P<rest>/.*)?",
+    )
+    @authed
+    def plugin_rest(req: Request, auth: AuthData) -> Response:
+        return plugins.rest_response(
+            req.params["ptype"], req.params["pname"],
+            req.params.get("rest") or "/", req.query,
+        )
+
     # -- stats ---------------------------------------------------------------
     @app.route("GET", "/stats\\.json")
     @authed

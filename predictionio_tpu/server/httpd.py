@@ -74,10 +74,18 @@ def error_response(status: int, message: str) -> Response:
 
 
 class HTTPApp:
-    """Route table: (method, compiled path regex) -> handler."""
+    """Route table: (method, compiled path regex) -> handler.
 
-    def __init__(self, name: str = "server"):
+    ``access_key``, when set, gates EVERY route behind ``?accessKey=``
+    (the KeyAuthentication role, common/.../KeyAuthentication.scala:33, as
+    the dashboard/admin servers use it, Dashboard.scala:47).  Servers with
+    per-app key auth (event server) leave it unset and authenticate
+    per-route instead.
+    """
+
+    def __init__(self, name: str = "server", access_key: str | None = None):
         self.name = name
+        self.access_key = access_key
         self._routes: list[tuple[str, re.Pattern, Handler]] = []
 
     def route(self, method: str, pattern: str):
@@ -92,6 +100,11 @@ class HTTPApp:
         return deco
 
     def handle(self, req: Request) -> Response:
+        if (
+            self.access_key is not None
+            and req.query.get("accessKey") != self.access_key
+        ):
+            return error_response(401, "Invalid accessKey.")
         path_matched = False
         for method, pattern, fn in self._routes:
             m = pattern.match(req.path)

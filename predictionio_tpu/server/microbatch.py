@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import threading
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Sequence
@@ -86,7 +87,16 @@ class MicroBatcher:
                     # loop down first) — nothing can await them anymore
                     pass
         finally:
-            self._executor.shutdown(wait=True)
+            # BOUNDED wait for the in-flight wave: a wedged batch_fn (e.g. a
+            # stalled device dispatch) must not hang server shutdown forever;
+            # past the deadline the daemon worker thread is abandoned
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if not self._dispatching:
+                        break
+                time.sleep(0.01)
+            self._executor.shutdown(wait=False)
 
     def _drain(self, loop: asyncio.AbstractEventLoop) -> None:
         """Worker-thread loop: keep dispatching waves until the queue is

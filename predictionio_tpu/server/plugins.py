@@ -27,7 +27,8 @@ OUTPUT_SNIFFER = "outputsniffer"
 
 class EventServerPlugin:
     """Event-ingest hook: ``process`` may mutate-or-raise (blocker) or just
-    observe (sniffer)."""
+    observe (sniffer).  ``handle_rest`` (optional) answers the server's
+    ``/plugins/<type>/<name>/...`` routes (EventServer.scala:154-206)."""
 
     plugin_name = "event-plugin"
     plugin_type = INPUT_SNIFFER
@@ -35,10 +36,15 @@ class EventServerPlugin:
     def process(self, app_id: int, channel_id: int | None, event) -> None:
         raise NotImplementedError
 
+    def handle_rest(self, path: str, query: dict) -> Any:
+        """Plugin-specific HTTP endpoint; return a JSON-able value."""
+        return {"message": f"{self.plugin_name} has no REST handler"}
+
 
 class EngineServerPlugin:
     """Serving hook: blockers transform (or veto, by raising) the rendered
-    prediction; sniffers observe asynchronously."""
+    prediction; sniffers observe asynchronously.  ``handle_rest`` (optional)
+    answers ``/plugins/<type>/<name>/...`` (CreateServer.scala:656-702)."""
 
     plugin_name = "engine-plugin"
     plugin_type = OUTPUT_SNIFFER
@@ -47,6 +53,10 @@ class EngineServerPlugin:
         self, engine_instance_id: str, query: Any, prediction: Any
     ) -> Any:
         raise NotImplementedError
+
+    def handle_rest(self, path: str, query: dict) -> Any:
+        """Plugin-specific HTTP endpoint; return a JSON-able value."""
+        return {"message": f"{self.plugin_name} has no REST handler"}
 
 
 class PluginContext:
@@ -113,6 +123,46 @@ class PluginContext:
         """Block until queued sniffer work is processed (tests/shutdown)."""
         if self._queue is not None:
             self._queue.join()
+
+    # -- HTTP introspection (the /plugins* route surface) --------------------
+    def descriptions(self) -> dict[str, dict[str, dict]]:
+        """{plugin_type: {plugin_name: {class}}} for GET /plugins.json
+        (EventServer.scala:154-165, CreateServer.scala:656-668)."""
+        out: dict[str, dict[str, dict]] = {}
+        for p in self._plugins:
+            out.setdefault(p.plugin_type, {})[p.plugin_name] = {
+                "class": type(p).__qualname__
+            }
+        return out
+
+    def find(self, plugin_type: str, plugin_name: str):
+        for p in self.of_type(plugin_type):
+            if p.plugin_name == plugin_name:
+                return p
+        return None
+
+    def rest_response(self, plugin_type: str, plugin_name: str,
+                      path: str, query: dict):
+        """Dispatch a /plugins/<type>/<name>/<path> request to the plugin's
+        ``handle_rest``, wrapping the result as an HTTP Response."""
+        from predictionio_tpu.server.httpd import (
+            Response,
+            error_response,
+            json_response,
+        )
+
+        p = self.find(plugin_type, plugin_name)
+        if p is None:
+            return error_response(
+                404, f"no {plugin_type} plugin named {plugin_name!r}"
+            )
+        handler = getattr(p, "handle_rest", None)
+        if handler is None:
+            return error_response(
+                404, f"plugin {plugin_name!r} has no REST handler"
+            )
+        out = handler(path or "/", query)
+        return out if isinstance(out, Response) else json_response(200, out)
 
     @classmethod
     def from_env(cls, env_var: str = "PIO_PLUGINS") -> "PluginContext":

@@ -421,6 +421,24 @@ def create_prediction_server_app(
         inst = deployed.reload_latest()
         return json_response(200, {"message": "Reloaded", "engineInstanceId": inst.id})
 
+    # -- plugins (CreateServer.scala:656-702) --------------------------------
+    @app.route("GET", "/plugins\\.json")
+    def list_plugins(req: Request) -> Response:
+        if not _authorized(req):
+            return error_response(401, "Invalid accessKey.")
+        return json_response(200, {"plugins": plugins.descriptions()})
+
+    @app.route(
+        "GET", "/plugins/(?P<ptype>[^/]+)/(?P<pname>[^/]+)(?P<rest>/.*)?"
+    )
+    def plugin_rest(req: Request) -> Response:
+        if not _authorized(req):
+            return error_response(401, "Invalid accessKey.")
+        return plugins.rest_response(
+            req.params["ptype"], req.params["pname"],
+            req.params.get("rest") or "/", req.query,
+        )
+
     @app.route("POST", "/stop")
     def stop(req: Request) -> Response:
         if not _authorized(req):

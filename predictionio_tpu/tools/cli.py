@@ -300,7 +300,12 @@ def do_eventserver(args) -> int:
 def do_adminserver(args) -> int:
     from predictionio_tpu.server.admin import create_admin_server
 
-    server = create_admin_server(host=args.ip, port=args.port, storage=get_storage())
+    server = create_admin_server(
+        host=args.ip,
+        port=args.port,
+        storage=get_storage(),
+        access_key=args.access_key,
+    )
     print(f"Admin server on http://{args.ip}:{server.port}")
     try:
         server.serve_forever()
@@ -313,7 +318,10 @@ def do_dashboard(args) -> int:
     from predictionio_tpu.server.dashboard import create_dashboard_server
 
     server = create_dashboard_server(
-        host=args.ip, port=args.port, storage=get_storage()
+        host=args.ip,
+        port=args.port,
+        storage=get_storage(),
+        access_key=args.access_key,
     )
     print(f"Dashboard on http://{args.ip}:{server.port}")
     try:
@@ -330,6 +338,71 @@ def do_run(args) -> int:
 
     sys.argv = [args.script] + (args.script_args or [])
     runpy.run_path(args.script, run_name="__main__")
+    return 0
+
+
+def do_daemon(args) -> int:
+    """`pio daemon <pidfile> <verb...>`: detach any pio verb with a pidfile
+    (bin/pio-daemon)."""
+    from predictionio_tpu.tools import daemon
+
+    cli_args = list(args.command)
+    if cli_args and cli_args[0] == "--":
+        cli_args = cli_args[1:]
+    if not cli_args:
+        print("daemon requires a command, e.g. pio daemon es.pid eventserver",
+              file=sys.stderr)
+        return 1
+    pid = daemon.spawn_daemon(cli_args, args.pidfile)
+    print(f"Started '{' '.join(cli_args)}' (pid {pid}, pidfile {args.pidfile})")
+    return 0
+
+
+def do_start_all(args) -> int:
+    """`pio start-all` (bin/pio-start-all): event server + admin API +
+    dashboard as pidfile-tracked daemons.  The reference also booted the
+    backing stores here; ours are embedded, so there is nothing else to
+    start."""
+    from predictionio_tpu.tools import daemon
+
+    try:
+        pids = daemon.start_all(
+            ip=args.ip,
+            ports={
+                "eventserver": str(args.event_port),
+                "adminserver": str(args.admin_port),
+                "dashboard": str(args.dashboard_port),
+            },
+        )
+    except RuntimeError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    for name, pid in pids.items():
+        print(f"{name}: pid {pid}")
+    return 0
+
+
+def do_stop_all(args) -> int:
+    """`pio stop-all` (bin/pio-stop-all): stop every pidfile-tracked
+    daemon."""
+    from predictionio_tpu.tools import daemon
+
+    stopped = daemon.stop_all()
+    if not stopped:
+        print("Nothing to stop.")
+    for name, was_running in stopped.items():
+        print(f"{name}: {'stopped' if was_running else 'was not running'}")
+    return 0
+
+
+def do_upgrade(args) -> int:
+    """`pio upgrade` (Console.scala's upgrade command): upgrades are a
+    package-manager concern here — print where to get the new version."""
+    print(
+        f"predictionio-tpu {__version__}: upgrade by installing a newer "
+        "package (pip install -U predictionio-tpu) — engine data and "
+        "models are stored under PIO_HOME and carry forward."
+    )
     return 0
 
 
@@ -550,12 +623,34 @@ def build_parser() -> argparse.ArgumentParser:
     ads = sub.add_parser("adminserver")
     ads.add_argument("--ip", default="0.0.0.0")
     ads.add_argument("--port", type=int, default=7071)
+    # KeyAuthentication parity (Dashboard.scala:47 applies it to the ops
+    # surfaces); TLS comes from PIO_SSL_CERTFILE/KEYFILE like every server
+    ads.add_argument("--access-key", default=None)
     ads.set_defaults(fn=do_adminserver)
 
     db = sub.add_parser("dashboard")
     db.add_argument("--ip", default="0.0.0.0")
     db.add_argument("--port", type=int, default=9000)
+    db.add_argument("--access-key", default=None)
     db.set_defaults(fn=do_dashboard)
+
+    dm = sub.add_parser("daemon")
+    dm.add_argument("pidfile")
+    dm.add_argument("command", nargs=argparse.REMAINDER)
+    dm.set_defaults(fn=do_daemon)
+
+    sa = sub.add_parser("start-all")
+    sa.add_argument("--ip", default="0.0.0.0")
+    sa.add_argument("--event-port", type=int, default=7070)
+    sa.add_argument("--admin-port", type=int, default=7071)
+    sa.add_argument("--dashboard-port", type=int, default=9000)
+    sa.set_defaults(fn=do_start_all)
+
+    st = sub.add_parser("stop-all")
+    st.set_defaults(fn=do_stop_all)
+
+    up = sub.add_parser("upgrade")
+    up.set_defaults(fn=do_upgrade)
 
     rn = sub.add_parser("run")
     rn.add_argument("script")

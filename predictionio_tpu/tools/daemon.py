@@ -1,0 +1,156 @@
+"""Daemonized server management: the bin/pio-daemon, bin/pio-start-all and
+bin/pio-stop-all role (reference repo, bin/).
+
+The reference shell scripts nohup a `pio` command with a pidfile
+(`bin/pio-daemon <pidfile> <command...>`) and start/stop the single-node
+service stack.  Here the backing stores are embedded (sqlite/parquet), so
+"all" is the framework's own servers: event server (:7070), admin API
+(:7071) and dashboard (:9000), each spawned as a detached `python -m
+predictionio_tpu.tools.cli <verb>` process whose pid lands in
+``$PIO_HOME/pids/<name>.pid`` and whose output goes to
+``$PIO_HOME/logs/<name>.log``.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+
+def pio_home() -> Path:
+    return Path(
+        os.environ.get("PIO_HOME", str(Path.home() / ".predictionio_tpu"))
+    )
+
+
+def _pid_dir() -> Path:
+    d = pio_home() / "pids"
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _log_dir() -> Path:
+    d = pio_home() / "logs"
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def spawn_daemon(
+    cli_args: list[str],
+    pidfile: Path | str,
+    log_path: Path | str | None = None,
+) -> int:
+    """Detach ``python -m predictionio_tpu.tools.cli <cli_args>`` and record
+    its pid (the pio-daemon contract: nohup + pidfile)."""
+    pidfile = Path(pidfile)
+    if pid_alive(read_pidfile(pidfile)):
+        raise RuntimeError(
+            f"{pidfile} already points at a running process; "
+            "stop it first (pio stop-all)"
+        )
+    log_path = Path(log_path) if log_path else _log_dir() / (
+        pidfile.stem + ".log"
+    )
+    log_f = open(log_path, "ab")
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "predictionio_tpu.tools.cli", *cli_args],
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL,
+            start_new_session=True,  # survive the parent shell (nohup role)
+        )
+    finally:
+        log_f.close()
+    pidfile.parent.mkdir(parents=True, exist_ok=True)
+    pidfile.write_text(str(proc.pid))
+    return proc.pid
+
+
+def read_pidfile(pidfile: Path | str) -> int | None:
+    try:
+        return int(Path(pidfile).read_text().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def pid_alive(pid: int | None) -> bool:
+    """True only when ``pid`` is a live process AND still one of ours.
+
+    Pids recycle: after a reboot or daemon crash, a stale pidfile may point
+    at an unrelated process — signalling it would kill an innocent victim,
+    and treating it as "already running" would wedge start-all until the
+    user hand-deletes the file.  On Linux the /proc cmdline check
+    disambiguates; elsewhere we fall back to liveness only.
+    """
+    if pid is None:
+        return False
+    try:
+        os.kill(pid, 0)
+    except (ProcessLookupError, PermissionError):
+        return False
+    try:
+        cmdline = Path(f"/proc/{pid}/cmdline").read_bytes()
+    except OSError:
+        return True  # no procfs: can't disambiguate, assume it's ours
+    return b"predictionio_tpu" in cmdline
+
+
+def stop_pidfile(pidfile: Path | str, timeout: float = 10.0) -> bool:
+    """SIGTERM the recorded pid (if still ours), wait for exit, remove the
+    pidfile."""
+    pidfile = Path(pidfile)
+    pid = read_pidfile(pidfile)
+    stopped = False
+    if pid_alive(pid):
+        os.kill(pid, signal.SIGTERM)
+        deadline = time.monotonic() + timeout
+        while pid_alive(pid) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if pid_alive(pid):
+            os.kill(pid, signal.SIGKILL)
+        stopped = True
+    pidfile.unlink(missing_ok=True)
+    return stopped
+
+
+#: the single-node service stack and its default ports (pio-start-all)
+STACK = (
+    ("eventserver", "7070"),
+    ("adminserver", "7071"),
+    ("dashboard", "9000"),
+)
+
+
+def start_all(
+    ip: str = "0.0.0.0",
+    ports: dict[str, str] | None = None,
+    extra_args: dict[str, list[str]] | None = None,
+) -> dict[str, int]:
+    """Start the full stack; returns {name: pid}."""
+    ports = ports or {}
+    extra_args = extra_args or {}
+    pids = {}
+    for name, default_port in STACK:
+        pidfile = _pid_dir() / f"{name}.pid"
+        args = [
+            name,
+            "--ip", ip,
+            "--port", str(ports.get(name, default_port)),
+            *extra_args.get(name, []),
+        ]
+        pids[name] = spawn_daemon(args, pidfile)
+    return pids
+
+
+def stop_all() -> dict[str, bool]:
+    """Stop every pidfile under $PIO_HOME/pids (not just the stack names,
+    so `pio daemon` one-offs are reaped too)."""
+    out = {}
+    for pidfile in sorted(_pid_dir().glob("*.pid")):
+        out[pidfile.stem] = stop_pidfile(pidfile)
+    return out

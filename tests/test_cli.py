@@ -138,6 +138,123 @@ class TestAdminAPI:
         assert req("DELETE", "/cmd/app/adminapp").status == 200
         assert req("GET", "/cmd/app/adminapp").status == 404
 
+    def test_admin_key_auth(self, global_storage):
+        """KeyAuthentication on the admin surface: 401 without the key,
+        200 with it (Dashboard.scala:47 pattern)."""
+        from predictionio_tpu.server.admin import create_admin_app
+        from predictionio_tpu.server.httpd import Request
+
+        app = create_admin_app(global_storage, access_key="adminsecret")
+        assert app.handle(Request("GET", "/", {}, {})).status == 401
+        assert (
+            app.handle(
+                Request("GET", "/", {"accessKey": "wrong"}, {})
+            ).status
+            == 401
+        )
+        assert (
+            app.handle(
+                Request("GET", "/", {"accessKey": "adminsecret"}, {})
+            ).status
+            == 200
+        )
+
+
+class TestDaemonVerbs:
+    """pio daemon / start-all / stop-all / upgrade (bin/pio-daemon,
+    bin/pio-start-all, bin/pio-stop-all, Console upgrade)."""
+
+    def _wait_http(self, port, path="/", timeout=30):
+        import time
+        import urllib.request
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=2
+                ) as r:
+                    return r.status
+            except Exception:
+                time.sleep(0.2)
+        raise TimeoutError(f"port {port} never served {path}")
+
+    def test_start_all_stop_all(self, tmp_path, monkeypatch):
+        import socket
+
+        monkeypatch.setenv("PIO_HOME", str(tmp_path))
+        ports = []
+        socks = []
+        for _ in range(3):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            socks.append(s)
+        for s in socks:
+            s.close()
+        ev, ad, db = ports
+        assert (
+            cli_main(
+                [
+                    "start-all",
+                    "--ip", "127.0.0.1",
+                    "--event-port", str(ev),
+                    "--admin-port", str(ad),
+                    "--dashboard-port", str(db),
+                ]
+            )
+            == 0
+        )
+        try:
+            pid_dir = tmp_path / "pids"
+            assert {p.name for p in pid_dir.glob("*.pid")} == {
+                "eventserver.pid", "adminserver.pid", "dashboard.pid",
+            }
+            assert self._wait_http(ev) == 200  # event server alive
+            assert self._wait_http(ad) == 200  # admin alive
+            assert self._wait_http(db) == 200  # dashboard alive
+            # double start refuses while pids are alive
+            assert cli_main(["start-all", "--event-port", str(ev)]) == 1
+        finally:
+            assert cli_main(["stop-all"]) == 0
+        assert list((tmp_path / "pids").glob("*.pid")) == []
+        # every process actually exited
+        import urllib.error
+        import urllib.request
+
+        with pytest.raises(Exception):
+            urllib.request.urlopen(f"http://127.0.0.1:{ev}/", timeout=2)
+
+    def test_daemon_one_off(self, tmp_path, monkeypatch):
+        import socket
+
+        monkeypatch.setenv("PIO_HOME", str(tmp_path))
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        pidfile = tmp_path / "pids" / "oneoff.pid"
+        assert (
+            cli_main(
+                [
+                    "daemon", str(pidfile), "--",
+                    "eventserver", "--ip", "127.0.0.1", "--port", str(port),
+                ]
+            )
+            == 0
+        )
+        try:
+            assert self._wait_http(port) == 200
+            from predictionio_tpu.tools import daemon
+
+            assert daemon.pid_alive(daemon.read_pidfile(pidfile))
+        finally:
+            assert cli_main(["stop-all"]) == 0
+
+    def test_upgrade_stub(self, capsys):
+        assert cli_main(["upgrade"]) == 0
+        assert "upgrade" in capsys.readouterr().out
+
 
 class TestDashboard:
     def test_dashboard_lists_evaluations(self, global_storage):
@@ -169,3 +286,14 @@ class TestDashboard:
             Request("GET", "/engine_instances/eval1/evaluator_results.json", {}, {})
         )
         assert json.loads(rj.encoded()[0])["best"] == 0.5
+
+    def test_dashboard_key_auth(self, global_storage):
+        from predictionio_tpu.server.dashboard import create_dashboard_app
+        from predictionio_tpu.server.httpd import Request
+
+        app = create_dashboard_app(global_storage, access_key="dashkey")
+        assert app.handle(Request("GET", "/", {}, {})).status == 401
+        assert (
+            app.handle(Request("GET", "/", {"accessKey": "dashkey"}, {})).status
+            == 200
+        )

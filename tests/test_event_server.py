@@ -312,6 +312,68 @@ def test_server_binds_and_serves(served):
         server.shutdown()
 
 
+class TestPluginRoutes:
+    """/plugins* HTTP surface (EventServer.scala:154-206): list + dispatch
+    + auth."""
+
+    def _app(self, storage):
+        from predictionio_tpu.server.plugins import (
+            INPUT_SNIFFER,
+            EventServerPlugin,
+            PluginContext,
+        )
+
+        class Sniffy(EventServerPlugin):
+            plugin_name = "sniffy"
+            plugin_type = INPUT_SNIFFER
+
+            def process(self, app_id, channel_id, event):
+                pass
+
+            def handle_rest(self, path, query):
+                return {"echo": path, "q": query.get("x")}
+
+        ctx = PluginContext()
+        ctx.register(Sniffy())
+        return create_event_server_app(storage, plugins=ctx)
+
+    def test_list_requires_auth(self, served):
+        _, storage, _ = served
+        app = self._app(storage)
+        resp = app.handle(make_req("GET", "/plugins.json"))
+        assert resp.status == 401
+        resp = app.handle(
+            make_req("GET", "/plugins.json", query={"accessKey": "SECRET"})
+        )
+        assert resp.status == 200
+        assert resp.body["plugins"]["inputsniffer"]["sniffy"]["class"]
+
+    def test_dispatches_to_plugin_handler(self, served):
+        _, storage, _ = served
+        app = self._app(storage)
+        resp = app.handle(
+            make_req(
+                "GET",
+                "/plugins/inputsniffer/sniffy/hello",
+                query={"accessKey": "SECRET", "x": "1"},
+            )
+        )
+        assert resp.status == 200
+        assert resp.body == {"echo": "/hello", "q": "1"}
+
+    def test_unknown_plugin_404(self, served):
+        _, storage, _ = served
+        app = self._app(storage)
+        resp = app.handle(
+            make_req(
+                "GET",
+                "/plugins/inputsniffer/nope/x",
+                query={"accessKey": "SECRET"},
+            )
+        )
+        assert resp.status == 404
+
+
 class TestReviewRegressions:
     """Fixes from review: mixed-target stats sort, bad fired_at, encoded ids."""
 

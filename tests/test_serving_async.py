@@ -134,6 +134,70 @@ class TestMicroBatcher:
         asyncio.run(run())
 
 
+class TestPredictionServerPluginRoutes:
+    """/plugins* on the engine server (CreateServer.scala:656-702)."""
+
+    def _app(self, access_key=None):
+        import threading
+        import types
+
+        from predictionio_tpu.server.plugins import (
+            OUTPUT_SNIFFER,
+            EngineServerPlugin,
+            PluginContext,
+        )
+        from predictionio_tpu.server.prediction_server import (
+            DeployedEngine,
+            create_prediction_server_app,
+        )
+
+        class Obs(EngineServerPlugin):
+            plugin_name = "obs"
+            plugin_type = OUTPUT_SNIFFER
+
+            def process(self, iid, query, prediction):
+                pass
+
+            def handle_rest(self, path, query):
+                return {"path": path}
+
+        deployed = DeployedEngine.__new__(DeployedEngine)
+        deployed._lock = threading.RLock()
+        deployed.instance = types.SimpleNamespace(id="t")
+        deployed.storage = None
+        deployed.algorithms = []
+        deployed.models = []
+        ctx = PluginContext()
+        ctx.register(Obs())
+        return create_prediction_server_app(
+            deployed, access_key=access_key, plugins=ctx
+        )
+
+    def test_list_and_dispatch(self):
+        from predictionio_tpu.server.httpd import Request
+
+        app = self._app()
+        r = app.handle(Request("GET", "/plugins.json", {}, {}))
+        assert r.status == 200
+        assert r.body["plugins"]["outputsniffer"]["obs"]["class"]
+        r = app.handle(Request("GET", "/plugins/outputsniffer/obs/ping", {}, {}))
+        assert r.status == 200 and r.body == {"path": "/ping"}
+        r = app.handle(Request("GET", "/plugins/outputsniffer/none/x", {}, {}))
+        assert r.status == 404
+
+    def test_key_auth(self):
+        from predictionio_tpu.server.httpd import Request
+
+        app = self._app(access_key="k1")
+        assert app.handle(Request("GET", "/plugins.json", {}, {})).status == 401
+        assert (
+            app.handle(
+                Request("GET", "/plugins.json", {"accessKey": "k1"}, {})
+            ).status
+            == 200
+        )
+
+
 def _get(url: str):
     with urllib.request.urlopen(url, timeout=5) as r:
         return r.status, r.read()
