@@ -459,6 +459,18 @@ class EventFrame:
     def __len__(self) -> int:
         return len(self.event)
 
+    def take(self, sel) -> "EventFrame":
+        """Row subset by boolean mask or index array (all columns)."""
+        import dataclasses
+
+        return EventFrame(
+            **{
+                f.name: (v[sel] if v is not None else None)
+                for f in dataclasses.fields(self)
+                for v in [getattr(self, f.name)]
+            }
+        )
+
     @classmethod
     def from_events(cls, events: Iterable[Event]) -> "EventFrame":
         evs = list(events)

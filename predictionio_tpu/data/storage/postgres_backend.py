@@ -39,24 +39,52 @@ from predictionio_tpu.data.storage.sqlite_backend import (
 
 _REPLACE_RE = re.compile(r"INSERT OR REPLACE INTO (\S+) \(([^)]*)\)", re.I)
 
+#: upsert conflict targets per table — the PRIMARY KEY column from the DDL
+#: in sqlite_backend.SQLiteMetadata.  An unknown table fails loudly rather
+#: than guessing (the old first-column heuristic happened to be right for
+#: every current table but would corrupt silently for a new one).
+_CONFLICT_TARGETS = {
+    "pio_engine_instances": "id",
+    "pio_evaluation_instances": "id",
+    "pio_models": "id",
+}
+
+
+def _conflict_target(table: str) -> str:
+    if table.startswith("pio_event_"):  # event tables: id TEXT PRIMARY KEY
+        return "id"
+    try:
+        return _CONFLICT_TARGETS[table]
+    except KeyError:
+        raise ValueError(
+            f"no conflict target registered for upsert into {table}; add "
+            "its PRIMARY KEY column to _CONFLICT_TARGETS"
+        ) from None
+
 
 def _translate(sql: str) -> str:
     """SQLite dialect -> PostgreSQL dialect."""
     m = _REPLACE_RE.search(sql)
     if m:
         table, cols = m.group(1), m.group(2)
-        first_col = cols.split(",")[0].strip()
+        target = _conflict_target(table)
         assignments = ", ".join(
-            f"{c.strip()} = EXCLUDED.{c.strip()}"
-            for c in cols.split(",")[1:]
+            f"{c} = EXCLUDED.{c}"
+            for c in (c.strip() for c in cols.split(","))
+            if c != target
         )
         sql = _REPLACE_RE.sub(f"INSERT INTO {table} ({cols})", sql)
         sql += (
-            f" ON CONFLICT ({first_col}) DO UPDATE SET {assignments}"
+            f" ON CONFLICT ({target}) DO UPDATE SET {assignments}"
             if assignments
-            else f" ON CONFLICT ({first_col}) DO NOTHING"
+            else f" ON CONFLICT ({target}) DO NOTHING"
         )
     sql = sql.replace("INTEGER PRIMARY KEY AUTOINCREMENT", "BIGSERIAL PRIMARY KEY")
+    if re.match(r"\s*CREATE TABLE", sql, re.I):
+        # sqlite INTEGER is 64-bit; Postgres INTEGER is int4, which
+        # epoch-millisecond columns (eventTime, creationTime, ...) overflow
+        # — every event insert would fail with "integer out of range"
+        sql = re.sub(r"\bINTEGER\b", "BIGINT", sql)
     sql = sql.replace(" BLOB ", " BYTEA ")
     sql = sql.replace("?", "%s")
     # serial-id tables: surface the generated id through the lastrowid shim
@@ -106,10 +134,18 @@ class PGClient:
                 self._conn = psycopg2.connect(url)
                 self._conn.autocommit = True
             except ImportError:
-                raise ImportError(
-                    "the postgres storage backend requires psycopg or "
-                    "psycopg2; install one or use TYPE=sqlite"
-                ) from None
+                # last resort: the bundled ctypes binding over libpq —
+                # no Python driver needed, only the C client library
+                # (present on this image as libpq.so.5)
+                from predictionio_tpu.data.storage import pq_driver
+
+                if not pq_driver.available():
+                    raise ImportError(
+                        "the postgres storage backend needs psycopg, "
+                        "psycopg2, or the libpq C library for the bundled "
+                        "ctypes driver; none found — use TYPE=sqlite"
+                    ) from None
+                self._conn = pq_driver.connect(url)
         self.lock = threading.RLock()
 
     def execute(self, sql: str, params: Sequence = ()):
@@ -141,7 +177,16 @@ class PGLEvents(SQLiteLEvents):
 
 
 class PGPEvents(SQLitePEvents):
-    pass
+    def _shard_expr(self, n_shards: int) -> str:
+        """Server-side entity-hash shard: identical to
+        parquet_backend.entity_shard (int.from_bytes(md5(f"{type}-{id}")
+        [:4], "big") % n) so every backend splits rows the same way.  The
+        first 8 md5 hex chars ARE the first 4 digest bytes big-endian;
+        bit(32)->bigint zero-extends, keeping the value unsigned."""
+        return (
+            "(('x' || substr(md5(entityType || '-' || entityId), 1, 8))"
+            f"::bit(32)::bigint % {int(n_shards)})"
+        )
 
 
 class PGApps(SQLiteApps):

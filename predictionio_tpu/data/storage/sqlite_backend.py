@@ -280,7 +280,10 @@ class SQLitePEvents(base.PEvents):
         )
         if f.limit is not None and f.limit >= 0:
             sql += f" LIMIT {int(f.limit)}"
-        rows = self.client.query(sql, params)
+        return self._rows_to_frame(self.client.query(sql, params))
+
+    @staticmethod
+    def _rows_to_frame(rows) -> EventFrame:
         n = len(rows)
         event = np.empty(n, dtype=object)
         etype = np.empty(n, dtype=object)
@@ -327,6 +330,71 @@ class SQLitePEvents(base.PEvents):
         self.client.executemany(
             f"DELETE FROM {table} WHERE id = ?", [(i,) for i in event_ids]
         )
+
+    # -- entity-hash scan sharding ------------------------------------------
+    #: default logical shard count for multi-process scans
+    N_SCAN_SHARDS = 8
+
+    def _shard_expr(self, n_shards: int) -> str | None:
+        """SQL expression computing the entity-hash shard of a row, or None
+        when the dialect can't (scan once + split on the host instead).
+        Embedded sqlite has no md5(), and the rows are local anyway."""
+        return None
+
+    def iter_shards(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        filter: EventFilter | None = None,
+        shards: Sequence[int] | None = None,
+        n_shards: int | None = None,
+    ):
+        """Yield (shard, EventFrame) using the same MD5 entity-hash shard
+        function as the parquet layout (HBEventsUtil.scala:83's row-key
+        prefix role), so multi-process training can split ANY event store
+        identically: process p consumes ``shards=range(p, n, P)``.
+
+        Server dialects that can hash in SQL (Postgres) filter rows
+        server-side, so each process only transfers its own shards.
+        """
+        from predictionio_tpu.data.storage.parquet_backend import entity_shard
+
+        n = n_shards or self.N_SCAN_SHARDS
+        want = list(range(n)) if shards is None else list(shards)
+        expr = self._shard_expr(n)
+        f = filter or EventFilter()
+        # a LIMIT is global across the scan (find() semantics), which a
+        # per-shard WHERE cannot express — use the host-split path so every
+        # backend returns identical rows for identical filters
+        if expr is None or f.limit is not None:
+            frame = self.find(app_id, channel_id, filter)
+            shard_of = np.fromiter(
+                (
+                    entity_shard(t, e, n)
+                    for t, e in zip(frame.entity_type, frame.entity_id)
+                ),
+                np.int64,
+                len(frame),
+            )
+            for k in want:
+                yield k, frame.take(shard_of == k)
+            return
+        table = self.levents._ensure(app_id, channel_id)
+        where, params = SQLiteLEvents._where(f)
+        order = "DESC" if f.reversed else "ASC"
+        for k in want:
+            shard_where = (
+                f"{where} AND {expr} = {int(k)}"
+                if where
+                else f" WHERE {expr} = {int(k)}"
+            )
+            sql = (
+                f"SELECT event, entityType, entityId, targetEntityType, "
+                f"targetEntityId, properties, eventTime, id, tags, prId, "
+                f"creationTime FROM {table}{shard_where} "
+                f"ORDER BY eventTime {order}"
+            )
+            yield k, self._rows_to_frame(self.client.query(sql, params))
 
 
 # ---------------------------------------------------------------------------

@@ -48,6 +48,7 @@ class NCFAlgorithmParams:
     num_epochs: int = 5
     batch_size: int = 8192
     positive_threshold: float = 4.0  # ratings >= this are positives
+    neg_power: float = 0.0  # see ops.ncf.NCFParams.neg_power
     seed: int = 3
 
     params_aliases = {
@@ -57,6 +58,7 @@ class NCFAlgorithmParams:
         "numEpochs": "num_epochs",
         "batchSize": "batch_size",
         "positiveThreshold": "positive_threshold",
+        "negPower": "neg_power",
     }
 
 
@@ -151,6 +153,7 @@ class NCFAlgorithm(Algorithm):
                 learning_rate=p.learning_rate,
                 num_epochs=p.num_epochs,
                 batch_size=p.batch_size,
+                neg_power=p.neg_power,
                 seed=p.seed,
             ),
             mesh=mesh,

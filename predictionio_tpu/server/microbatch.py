@@ -21,7 +21,6 @@ import asyncio
 import threading
 import time
 from collections import deque
-from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Sequence
 
 
@@ -46,9 +45,6 @@ class MicroBatcher:
         self._lock = threading.Lock()
         self._dispatching = False
         self._closed = False
-        self._executor = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="microbatch"
-        )
         #: wave-size histogram for the status page ({batch_size: count})
         self.wave_sizes: dict[int, int] = {}
 
@@ -59,44 +55,46 @@ class MicroBatcher:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
             self._pending.append((item, fut))
-            # dispatch under the lock: close() sets _closed under the same
-            # lock before shutting the executor down, so a submit that
-            # passed the check above cannot hit a dead executor
             if not self._dispatching:
                 self._dispatching = True
-                loop.run_in_executor(self._executor, self._drain, loop)
+                # one DAEMON drain thread per burst; _dispatching guarantees
+                # at most one runs, serializing device access.  Daemon
+                # matters: a wedged batch_fn (stalled device dispatch) must
+                # not block interpreter exit — a ThreadPoolExecutor worker
+                # would be joined by concurrent.futures' atexit hook and
+                # hang the process at shutdown.
+                threading.Thread(
+                    target=self._drain,
+                    args=(loop,),
+                    name="microbatch",
+                    daemon=True,
+                ).start()
         return await fut
 
     def close(self) -> None:
-        """Stop accepting work, fail anything still queued, and wait for the
-        in-flight wave — otherwise queued submit() futures would hang until
-        client timeout and late submits would hit a dead executor."""
+        """Stop accepting work, fail anything still queued, and wait
+        BOUNDEDLY for the in-flight wave — queued submit() futures must not
+        hang until client timeout, and a wedged batch_fn (e.g. a stalled
+        device dispatch) must not hang shutdown: past the deadline the
+        daemon drain thread is simply abandoned."""
         with self._lock:
             self._closed = True
             dropped = list(self._pending)
             self._pending.clear()
         err = RuntimeError("MicroBatcher closed during shutdown")
-        try:
-            for _, fut in dropped:
-                try:
-                    fut.get_loop().call_soon_threadsafe(
-                        _fail_if_pending, fut, err
-                    )
-                except RuntimeError:
-                    # the futures' loop is already closed (server tore the
-                    # loop down first) — nothing can await them anymore
-                    pass
-        finally:
-            # BOUNDED wait for the in-flight wave: a wedged batch_fn (e.g. a
-            # stalled device dispatch) must not hang server shutdown forever;
-            # past the deadline the daemon worker thread is abandoned
-            deadline = time.monotonic() + 5.0
-            while time.monotonic() < deadline:
-                with self._lock:
-                    if not self._dispatching:
-                        break
-                time.sleep(0.01)
-            self._executor.shutdown(wait=False)
+        for _, fut in dropped:
+            try:
+                fut.get_loop().call_soon_threadsafe(_fail_if_pending, fut, err)
+            except RuntimeError:
+                # the futures' loop is already closed (server tore the
+                # loop down first) — nothing can await them anymore
+                pass
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._dispatching:
+                    return
+            time.sleep(0.01)
 
     def _drain(self, loop: asyncio.AbstractEventLoop) -> None:
         """Worker-thread loop: keep dispatching waves until the queue is

@@ -24,23 +24,72 @@ from predictionio_tpu.data.storage.base import (
 from predictionio_tpu.data.store import AppNotFoundError, LEventStore, PEventStore
 
 
+def _have_pg_driver() -> bool:
+    """psycopg, psycopg2, or the bundled ctypes-libpq binding."""
+    try:
+        import psycopg  # noqa: F401
+
+        return True
+    except ImportError:
+        pass
+    try:
+        import psycopg2  # noqa: F401
+
+        return True
+    except ImportError:
+        pass
+    from predictionio_tpu.data.storage import pq_driver
+
+    return pq_driver.available()
+
+
+def _pg_exec(url: str, sql: str) -> None:
+    """Run one admin statement through whichever driver is present."""
+    try:
+        import psycopg
+
+        with psycopg.connect(url, autocommit=True) as conn:
+            conn.execute(sql)
+        return
+    except ImportError:
+        pass
+    try:
+        import psycopg2
+
+        conn = psycopg2.connect(url)
+        try:
+            conn.autocommit = True
+            conn.cursor().execute(sql)
+        finally:
+            conn.close()
+        return
+    except ImportError:
+        pass
+    from predictionio_tpu.data.storage import pq_driver
+
+    conn = pq_driver.connect(url)
+    try:
+        conn.cursor().execute(sql)
+    finally:
+        conn.close()
+
+
 @pytest.fixture(scope="session")
 def pg_server(tmp_path_factory):
     """A throwaway local PostgreSQL server, if the environment can host one.
 
     Yields a base URL or None (callers skip).  Preference order: an
     operator-provided PIO_TEST_POSTGRES_URL, then initdb/pg_ctl binaries.
+    A Python driver is NOT required — the bundled ctypes-libpq binding
+    (data/storage/pq_driver.py) suffices; this image lacks the server
+    binaries themselves, which is the one remaining skip condition.
     """
     url = os.environ.get("PIO_TEST_POSTGRES_URL")
     if url:
         yield url
         return
     initdb, pg_ctl = shutil.which("initdb"), shutil.which("pg_ctl")
-    try:
-        import psycopg  # noqa: F401
-    except ImportError:
-        psycopg = None
-    if not (initdb and pg_ctl and psycopg):
+    if not (initdb and pg_ctl and _have_pg_driver()):
         yield None
         return
     d = tmp_path_factory.mktemp("pgdata")
@@ -86,19 +135,17 @@ def storage(request, tmp_path, pg_server):
         if pg_server is None:
             pytest.skip(
                 "no live PostgreSQL: set PIO_TEST_POSTGRES_URL or install "
-                "server binaries (initdb/pg_ctl) + psycopg"
+                "server binaries (initdb/pg_ctl); any of psycopg/psycopg2/"
+                "the bundled libpq ctypes driver will be used"
             )
         # fresh database per test for isolation; rewrite only the URL's
         # path component (a naive str.replace would mangle usernames like
         # postgres@ or silently no-op on custom database names)
         from urllib.parse import urlsplit, urlunsplit
 
-        import psycopg
-
         _pg_db_counter[0] += 1
         dbname = f"pio_test_{os.getpid()}_{_pg_db_counter[0]}"
-        with psycopg.connect(pg_server, autocommit=True) as conn:
-            conn.execute(f"CREATE DATABASE {dbname}")
+        _pg_exec(pg_server, f"CREATE DATABASE {dbname}")
         parts = urlsplit(pg_server)
         url = urlunsplit(parts._replace(path=f"/{dbname}"))
         env |= {
@@ -501,3 +548,77 @@ class TestPostgresDialect:
             description = None
 
         assert _Cursor(FakeNoRows()).lastrowid is None
+
+    def test_upsert_conflict_targets_are_explicit(self):
+        from predictionio_tpu.data.storage.postgres_backend import (
+            _conflict_target,
+            _translate,
+        )
+
+        assert _conflict_target("pio_models") == "id"
+        assert _conflict_target("pio_event_3_7") == "id"
+        with pytest.raises(ValueError, match="conflict target"):
+            _conflict_target("pio_new_table")
+        out = _translate(
+            "INSERT OR REPLACE INTO pio_models (id, models) VALUES (?, ?)"
+        )
+        assert "ON CONFLICT (id) DO UPDATE SET models = EXCLUDED.models" in out
+
+
+class TestPQDriver:
+    """The ctypes-libpq binding, server-independent parts: placeholder
+    rewriting and the text-protocol codecs.  (Live-server paths run through
+    the shared ``storage`` fixture wherever a server exists.)"""
+
+    def test_placeholders_to_dollar(self):
+        from predictionio_tpu.data.storage.pq_driver import (
+            placeholders_to_dollar,
+        )
+
+        assert (
+            placeholders_to_dollar("INSERT INTO t (a, b) VALUES (%s, %s)")
+            == "INSERT INTO t (a, b) VALUES ($1, $2)"
+        )
+        # literal %s inside a string stays untouched
+        assert (
+            placeholders_to_dollar("SELECT '%s' || a FROM t WHERE b = %s")
+            == "SELECT '%s' || a FROM t WHERE b = $1"
+        )
+        assert placeholders_to_dollar("SELECT 1") == "SELECT 1"
+
+    def test_param_encoding(self):
+        from predictionio_tpu.data.storage.pq_driver import _encode_param
+
+        assert _encode_param(None) == (None, 0)
+        assert _encode_param(True) == (b"t", 0)
+        assert _encode_param(False) == (b"f", 0)
+        assert _encode_param(7) == (b"7", 0)
+        assert _encode_param(2.5) == (b"2.5", 0)
+        assert _encode_param("x") == (b"x", 0)
+        assert _encode_param(b"\x00\xff") == (b"\x00\xff", 1)  # binary bytea
+
+    def test_value_decoding(self):
+        from predictionio_tpu.data.storage.pq_driver import _decode_value
+
+        assert _decode_value(b"42", 20) == 42
+        assert _decode_value(b"2.5", 701) == 2.5
+        assert _decode_value(b"t", 16) is True
+        assert _decode_value(b"f", 16) is False
+        assert _decode_value(b"\\x00ff", 17) == b"\x00\xff"
+        assert _decode_value(b"hello", 25) == "hello"
+
+    def test_libpq_loads_on_this_image(self):
+        """The image ships libpq.so.5; the binding must find it so a
+        configured server is reachable without any pip install."""
+        from predictionio_tpu.data.storage import pq_driver
+
+        assert pq_driver.available()
+
+    def test_connect_refused_raises_cleanly(self):
+        from predictionio_tpu.data.storage import pq_driver
+
+        with pytest.raises(pq_driver.PQError, match="connection failed"):
+            pq_driver.connect(
+                "postgresql://nobody@127.0.0.1:1/nosuchdb"
+                "?connect_timeout=2"
+            )
