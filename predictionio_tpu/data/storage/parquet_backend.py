@@ -237,6 +237,104 @@ class ParquetEventStore:
             _write_segment(d / f"shard={shard}", rows, seq)
         return ids
 
+    def append_frame(
+        self, frame, app_id: int, channel_id: int | None
+    ) -> None:
+        """Columnar bulk write: per-shard arrow tables built straight from
+        the EventFrame's numpy columns — no per-event Python objects.
+
+        This is the Spark-bulk-write role (JDBCPEvents.write:96,
+        HBPEvents.scala:80) at the scale the reference handles: 20M events
+        write in ~a minute on one host instead of the minutes-long
+        Event-object loop.  Rows without ids are written with a NULL
+        event_id (the "legacy data" class the dedup logic already treats as
+        always-distinct) — bulk-imported analytics streams don't pay 20M
+        uuid4 calls; point-mutation callers go through append_events.
+        """
+        n = len(frame)
+        if n == 0:
+            return
+        d = self.client.init(app_id, channel_id)
+        n_shards = self.client.n_shards(d)
+        seq = self.client.seq.next()
+
+        def js(col, default=""):
+            if col is None:
+                return np.full(n, default, object)
+            out = np.empty(n, object)
+            for i2, v in enumerate(col):
+                out[i2] = json.dumps(v) if v else default
+            return out
+
+        props = js(frame.properties)
+        tags = np.empty(n, object)
+        if frame.tags is None:
+            tags[:] = ""
+        else:
+            for i2, v in enumerate(frame.tags):
+                tags[i2] = json.dumps(list(v)) if v else ""
+        ctimes = (
+            frame.creation_time_ms
+            if frame.creation_time_ms is not None
+            else frame.event_time_ms
+        )
+        ids = (
+            frame.event_id
+            if frame.event_id is not None
+            else np.full(n, None, object)
+        )
+        table = pa.table(
+            {
+                "event_id": pa.array(ids, pa.string()),
+                "seq": pa.array(np.full(n, seq, np.int64)),
+                "event": pa.array(frame.event, pa.string()),
+                "entity_type": pa.array(frame.entity_type, pa.string()),
+                "entity_id": pa.array(frame.entity_id, pa.string()),
+                "target_entity_type": pa.array(
+                    frame.target_entity_type, pa.string()
+                ),
+                "target_entity_id": pa.array(
+                    frame.target_entity_id, pa.string()
+                ),
+                "event_time_ms": pa.array(frame.event_time_ms, pa.int64()),
+                "creation_time_ms": pa.array(ctimes, pa.int64()),
+                "properties": pa.array(props, pa.string()),
+                "tags": pa.array(tags, pa.string()),
+                "pr_id": pa.array(frame.pr_id, pa.string())
+                if frame.pr_id is not None
+                else pa.nulls(n, pa.string()),
+            }
+        ).select([f.name for f in _SCHEMA]).cast(_SCHEMA)
+        # shard by entity hash, md5-ing each UNIQUE entity once (entities
+        # are ~100x fewer than events at ML scale).  Pairs are coded as
+        # ints per column — no string concatenation, no separator pitfalls.
+        utypes, tcode = np.unique(frame.entity_type, return_inverse=True)
+        uids, icode = np.unique(frame.entity_id, return_inverse=True)
+        pair_code = tcode.astype(np.int64) * len(uids) + icode
+        upairs, inv = np.unique(pair_code, return_inverse=True)
+        shard_of_uniq = np.fromiter(
+            (
+                entity_shard(
+                    utypes[c // len(uids)], uids[c % len(uids)], n_shards
+                )
+                for c in upairs
+            ),
+            np.int64,
+            len(upairs),
+        )
+        shard_of = shard_of_uniq[inv]
+        for k in range(n_shards):
+            mask = shard_of == k
+            if not mask.any():
+                continue
+            shard_dir = d / f"shard={k}"
+            shard_dir.mkdir(parents=True, exist_ok=True)
+            tmp = shard_dir / f".seg-{seq}.parquet.tmp"
+            pq.write_table(
+                table.filter(pa.array(mask)), tmp, compression="zstd"
+            )
+            tmp.rename(shard_dir / f"seg-{seq}.parquet")
+
     def append_tombstones(
         self, event_ids: Sequence[str], app_id: int, channel_id: int | None
     ) -> None:
@@ -422,14 +520,16 @@ def _table_to_events(t: pa.Table) -> list[Event]:
 
 
 def _table_to_frame(t: pa.Table) -> EventFrame:
+    # to_numpy goes through pyarrow's C conversion — materially faster
+    # than to_pylist at 20M-row scans
     def col(name) -> np.ndarray:
-        return np.asarray(t.column(name).to_pylist(), dtype=object)
+        return t.column(name).to_numpy(zero_copy_only=False)
 
     props = np.empty(t.num_rows, dtype=object)
-    for i, s in enumerate(t.column("properties").to_pylist()):
+    for i, s in enumerate(col("properties")):
         props[i] = json.loads(s) if s else {}
     tags = np.empty(t.num_rows, dtype=object)
-    for i, s in enumerate(t.column("tags").to_pylist()):
+    for i, s in enumerate(col("tags")):
         tags[i] = tuple(json.loads(s)) if s else ()
     return EventFrame(
         event=col("event"),
@@ -437,14 +537,12 @@ def _table_to_frame(t: pa.Table) -> EventFrame:
         entity_id=col("entity_id"),
         target_entity_type=col("target_entity_type"),
         target_entity_id=col("target_entity_id"),
-        event_time_ms=np.asarray(t.column("event_time_ms").to_pylist(), np.int64),
+        event_time_ms=col("event_time_ms").astype(np.int64),
         properties=props,
         event_id=col("event_id"),
         tags=tags,
         pr_id=col("pr_id"),
-        creation_time_ms=np.asarray(
-            t.column("creation_time_ms").to_pylist(), np.int64
-        ),
+        creation_time_ms=col("creation_time_ms").astype(np.int64),
     )
 
 
@@ -562,7 +660,7 @@ class ParquetPEvents(PEvents):
     def write(
         self, frame: EventFrame, app_id: int, channel_id: int | None = None
     ) -> None:
-        self.store.append_events(frame.to_events(), app_id, channel_id)
+        self.store.append_frame(frame, app_id, channel_id)
 
     def delete(
         self, event_ids: Sequence[str], app_id: int, channel_id: int | None = None

@@ -182,10 +182,12 @@ class PGPEvents(SQLitePEvents):
         parquet_backend.entity_shard (int.from_bytes(md5(f"{type}-{id}")
         [:4], "big") % n) so every backend splits rows the same way.  The
         first 8 md5 hex chars ARE the first 4 digest bytes big-endian;
-        bit(32)->bigint zero-extends, keeping the value unsigned."""
+        bit(32)->bigint zero-extends, keeping the value unsigned.  MOD()
+        instead of the % operator: psycopg's client-side format parsing
+        treats a bare % in SQL as a placeholder marker and errors."""
         return (
-            "(('x' || substr(md5(entityType || '-' || entityId), 1, 8))"
-            f"::bit(32)::bigint % {int(n_shards)})"
+            "MOD(('x' || substr(md5(entityType || '-' || entityId), 1, 8))"
+            f"::bit(32)::bigint, {int(n_shards)})"
         )
 
 

@@ -161,6 +161,13 @@ class Cursor:
             placeholders_to_dollar(sql).encode(),
             n, None, values, lengths, formats, 0,
         )
+        if not res:
+            # NULL result: connection lost / out of memory — the error
+            # lives on the connection, not the (absent) result
+            msg = lib.PQerrorMessage(self._conn._conn).decode(
+                "utf-8", "replace"
+            ).strip()
+            raise PQError(msg or "PQexecParams returned no result")
         try:
             status = lib.PQresultStatus(res)
             if status not in (PGRES_COMMAND_OK, PGRES_TUPLES_OK):

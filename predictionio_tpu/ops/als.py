@@ -287,14 +287,53 @@ def _make_pallas_step(key_shapes, p: ALSParams, num_users_pad, num_items_pad):
         return _solve_factors(A, b, counts, p.reg, p.scale_reg_with_count, gram)
 
     @jax.jit
-    def step(u_plan, u_oth, u_rat, u_val,
-             i_plan, i_oth, i_rat, i_val, U, V):
-        U = half(u_plan, u_oth, u_rat, u_val, V, tpcu, nbu, num_users_pad)
-        V = half(i_plan, i_oth, i_rat, i_val, U, tpci, nbi, num_items_pad)
-        return U, V
+    def steps(u_plan, u_oth, u_rat, u_val,
+              i_plan, i_oth, i_rat, i_val, U, V, n_iters):
+        """ALL iterations inside one compiled program (lax.fori_loop with a
+        dynamic trip count, so num_iterations stays out of the compile
+        key).  One host dispatch per train instead of one per iteration —
+        on a remote-tunneled device each dispatch costs a ~100 ms round
+        trip, which at 20 iterations was a measurable slice of the whole
+        train."""
 
-    _STEP_CACHE[key] = step
-    return step
+        def body(_, uv):
+            U, V = uv
+            U = half(u_plan, u_oth, u_rat, u_val, V, tpcu, nbu,
+                     num_users_pad)
+            V = half(i_plan, i_oth, i_rat, i_val, U, tpci, nbi,
+                     num_items_pad)
+            return U, V
+
+        return jax.lax.fori_loop(0, n_iters, body, (U, V))
+
+    _STEP_CACHE[key] = steps
+    return steps
+
+
+#: diagnostics from the most recent _train_pallas staging (bench roofline
+#: reporting): padded row counts and block counts per scatter direction
+LAST_PLAN_INFO: dict = {}
+
+#: single-entry staging cache: the host sort/permute + device upload of the
+#: COO streams depends only on the DATA, not on hyperparameters or the
+#: iteration count — retraining on the same ratings (bench repeats, the
+#: deploy-retrain path, hyperparameter sweeps) reuses the staged device
+#: arrays, the way Spark caches a partitioned RDD across ALS iterations.
+#: Keyed by a full content hash (sha1 of the raw arrays, ~1 s at 20M rows vs
+#: ~13 s restaging); bounded to ONE dataset so stale streams don't pin HBM.
+_STAGE_CACHE: dict = {}
+
+
+def _data_fingerprint(*arrays) -> str:
+    import hashlib
+
+    h = hashlib.sha1()
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
 
 
 def _train_pallas(user_idx, item_idx, rating, num_users, num_items,
@@ -327,18 +366,45 @@ def _train_pallas(user_idx, item_idx, rating, num_users, num_items,
                 jnp.asarray(rat_p.reshape(shape2)),
                 jnp.asarray(val_p.reshape(shape2)))
 
-    up, u_plan, u_oth, u_rat, u_val = stage(user_idx, item_idx, num_users_pad)
-    ip, i_plan, i_oth, i_rat, i_val = stage(item_idx, user_idx, num_items_pad)
+    cache_key = (
+        _data_fingerprint(user_idx, item_idx, rating),
+        num_users_pad,
+        num_items_pad,
+    )
+    staged = _STAGE_CACHE.get(cache_key)
+    if staged is None:
+        # evict BEFORE staging: holding the old dataset's device streams
+        # while uploading the new ones would transiently double HBM use
+        _STAGE_CACHE.clear()
+        staged = (
+            stage(user_idx, item_idx, num_users_pad),
+            stage(item_idx, user_idx, num_items_pad),
+        )
+        _STAGE_CACHE[cache_key] = staged
+    (up, u_plan, u_oth, u_rat, u_val), (ip, i_plan, i_oth, i_rat, i_val) = (
+        staged
+    )
+    LAST_PLAN_INFO.update(
+        rank=p.rank,
+        width=als_pallas.row_width(p.rank),
+        rows_user=up.n_chunks * up.tiles_per_chunk * als_pallas.T,
+        rows_item=ip.n_chunks * ip.tiles_per_chunk * als_pallas.T,
+        blocks_user=up.n_blocks,
+        blocks_item=ip.n_blocks,
+        chunks_user=up.n_chunks,
+        chunks_item=ip.n_chunks,
+        precision=p.pallas_precision,
+    )
 
     U, V = _init_factors(p, num_users_pad, num_items_pad, num_users,
                          num_items, dtype)
-    step = _make_pallas_step(
+    steps = _make_pallas_step(
         (up.tiles_per_chunk, up.n_blocks, ip.tiles_per_chunk, ip.n_blocks),
         p, num_users_pad, num_items_pad,
     )
-    for _ in range(p.num_iterations):
-        U, V = step(u_plan, u_oth, u_rat, u_val,
-                    i_plan, i_oth, i_rat, i_val, U, V)
+    U, V = steps(u_plan, u_oth, u_rat, u_val,
+                 i_plan, i_oth, i_rat, i_val, U, V,
+                 jnp.int32(p.num_iterations))
     jax.block_until_ready((U, V))
     return ALSState(user_factors=U[:num_users], item_factors=V[:num_items])
 

@@ -11,8 +11,10 @@ dispatch overhead B-fold and rides the MXU.
 first query dispatches immediately; queries arriving while a dispatch is in
 flight queue up and go out together in the next wave, capped at
 ``max_batch``.  At low load every query is solo (minimum latency); at high
-load waves grow to the cap (maximum throughput).  Dispatches run on a single
-executor thread, which also serializes device access.
+load waves grow to the cap (maximum throughput).  Dispatches run on ONE
+long-lived DAEMON worker thread, which also serializes device access —
+daemon so a wedged ``batch_fn`` (a stalled device dispatch) can never block
+interpreter exit, long-lived so the hot path never pays thread creation.
 """
 
 from __future__ import annotations
@@ -42,8 +44,9 @@ class MicroBatcher:
         self.batch_fn = batch_fn
         self.max_batch = max_batch
         self._pending: deque[tuple[Any, asyncio.Future]] = deque()
-        self._lock = threading.Lock()
-        self._dispatching = False
+        self._cond = threading.Condition()
+        self._worker: threading.Thread | None = None
+        self._in_wave = False
         self._closed = False
         #: wave-size histogram for the status page ({batch_size: count})
         self.wave_sizes: dict[int, int] = {}
@@ -51,24 +54,16 @@ class MicroBatcher:
     async def submit(self, item: Any) -> Any:
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        with self._lock:
+        with self._cond:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
             self._pending.append((item, fut))
-            if not self._dispatching:
-                self._dispatching = True
-                # one DAEMON drain thread per burst; _dispatching guarantees
-                # at most one runs, serializing device access.  Daemon
-                # matters: a wedged batch_fn (stalled device dispatch) must
-                # not block interpreter exit — a ThreadPoolExecutor worker
-                # would be joined by concurrent.futures' atexit hook and
-                # hang the process at shutdown.
-                threading.Thread(
-                    target=self._drain,
-                    args=(loop,),
-                    name="microbatch",
-                    daemon=True,
-                ).start()
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._drain, name="microbatch", daemon=True
+                )
+                self._worker.start()
+            self._cond.notify()
         return await fut
 
     def close(self) -> None:
@@ -76,11 +71,12 @@ class MicroBatcher:
         BOUNDEDLY for the in-flight wave — queued submit() futures must not
         hang until client timeout, and a wedged batch_fn (e.g. a stalled
         device dispatch) must not hang shutdown: past the deadline the
-        daemon drain thread is simply abandoned."""
-        with self._lock:
+        daemon worker is simply abandoned."""
+        with self._cond:
             self._closed = True
             dropped = list(self._pending)
             self._pending.clear()
+            self._cond.notify_all()
         err = RuntimeError("MicroBatcher closed during shutdown")
         for _, fut in dropped:
             try:
@@ -91,24 +87,30 @@ class MicroBatcher:
                 pass
         deadline = time.monotonic() + 5.0
         while time.monotonic() < deadline:
-            with self._lock:
-                if not self._dispatching:
+            with self._cond:
+                if not self._in_wave:
                     return
             time.sleep(0.01)
 
-    def _drain(self, loop: asyncio.AbstractEventLoop) -> None:
-        """Worker-thread loop: keep dispatching waves until the queue is
-        empty, then clear the dispatching flag."""
+    def _drain(self) -> None:
+        """Persistent worker loop: sleep on the condition until work (or
+        close), then dispatch waves."""
         while True:
-            with self._lock:
-                if not self._pending:
-                    self._dispatching = False
+            with self._cond:
+                while not self._pending and not self._closed:
+                    self._cond.wait()
+                if self._closed and not self._pending:
                     return
                 wave = [
                     self._pending.popleft()
                     for _ in range(min(len(self._pending), self.max_batch))
                 ]
+                self._in_wave = True
             items = [it for it, _ in wave]
+            futures = [f for _, f in wave]
+            # all futures in a wave come from submit() calls on the same
+            # server loop; resolve with ONE loop wakeup
+            loop = futures[0].get_loop()
             try:
                 results = self.batch_fn(items)
                 if len(results) != len(items):
@@ -119,16 +121,19 @@ class MicroBatcher:
                 self.wave_sizes[len(items)] = (
                     self.wave_sizes.get(len(items), 0) + 1
                 )
-                # ONE loop wakeup per wave (call_soon_threadsafe writes to
-                # the loop's self-pipe — per-item calls would cost a syscall
-                # + handle each)
-                loop.call_soon_threadsafe(
-                    _resolve_wave, [f for _, f in wave], results, None
-                )
+                self._post(loop, futures, results, None)
             except Exception as e:
-                loop.call_soon_threadsafe(
-                    _resolve_wave, [f for _, f in wave], None, e
-                )
+                self._post(loop, futures, None, e)
+            finally:
+                with self._cond:
+                    self._in_wave = False
+
+    @staticmethod
+    def _post(loop, futures, results, error) -> None:
+        try:
+            loop.call_soon_threadsafe(_resolve_wave, futures, results, error)
+        except RuntimeError:
+            pass  # loop already closed during shutdown
 
 
 def _fail_if_pending(fut: asyncio.Future, err: BaseException) -> None:

@@ -243,7 +243,8 @@ def test_pg_shard_expr_matches_python_hash():
 
     expr = PGPEvents.__new__(PGPEvents)._shard_expr(8)
     assert "md5(entityType || '-' || entityId)" in expr
-    assert "::bit(32)::bigint % 8" in expr
+    assert "MOD(" in expr and "::bit(32)::bigint, 8" in expr
+    assert "%" not in expr  # psycopg treats bare % in SQL as a placeholder
     for et, eid in [("user", "u1"), ("item", "i!@#"), ("user", "ü")]:
         hexpfx = hashlib.md5(f"{et}-{eid}".encode()).hexdigest()[:8]
         assert int(hexpfx, 16) % 8 == entity_shard(et, eid, 8)

@@ -235,11 +235,26 @@ class TestPostgresDialect:
         out = _translate("INSERT INTO pio_apps (name, description) VALUES (?, ?)")
         assert out.endswith("RETURNING id")
 
-    def test_missing_driver_message(self):
+    def test_driver_chain_reaches_libpq(self):
+        """Without psycopg/psycopg2 the client falls through to the bundled
+        ctypes-libpq driver; a bad URL then surfaces a clean connection
+        error (not an ImportError).  Skipped where a Python driver exists
+        (it would win the fallback chain) or libpq is absent."""
+        for mod in ("psycopg", "psycopg2"):
+            try:
+                __import__(mod)
+                pytest.skip(f"{mod} installed; libpq fallback not reached")
+            except ImportError:
+                pass
+        from predictionio_tpu.data.storage import pq_driver
         from predictionio_tpu.data.storage.postgres_backend import PGClient
 
-        with pytest.raises(ImportError, match="psycopg"):
-            PGClient("postgresql://nope/nope")
+        if not pq_driver.available():
+            pytest.skip("libpq not present on this host")
+        with pytest.raises(pq_driver.PQError, match="connection failed"):
+            PGClient(
+                "postgresql://nope@127.0.0.1:1/nope?connect_timeout=2"
+            )
 
 
 class TestSSL:
