@@ -11,6 +11,7 @@ framework's Spark-replacement seam.
 from __future__ import annotations
 
 import abc
+import hashlib
 from dataclasses import dataclass, field, replace
 from datetime import datetime, timezone
 from typing import Any, Iterable, Iterator, Mapping, Sequence
@@ -292,6 +293,16 @@ def _manifest_part_names(raw: bytes) -> list[str]:
     return names.split(",") if names else []
 
 
+def entity_shard(entity_type: str, entity_id: str, n_shards: int) -> int:
+    """The HBEventsUtil.scala:83 row-key hash, reduced to a shard index.
+    Every backend's scan sharding (parquet layout, SQL entity-hash scans,
+    the remote daemon's shard protocol) keys on this one function.  Lives
+    here (not in the parquet module) so hash users never drag the pyarrow
+    import in."""
+    digest = hashlib.md5(f"{entity_type}-{entity_id}".encode()).digest()
+    return int.from_bytes(digest[:4], "big") % n_shards
+
+
 # ---------------------------------------------------------------------------
 # Event DAOs
 # ---------------------------------------------------------------------------
@@ -562,12 +573,39 @@ class EventFrame:
         return out
 
 
+def concat_frames(frames: Sequence["EventFrame"]) -> "EventFrame":
+    """Row-wise concatenation of EventFrames (all columns).  An optional
+    column is kept only when every input carries it — mixing frames from
+    different backends would otherwise fabricate ids/tags for some rows."""
+    frames = [f for f in frames if len(f)]
+    if not frames:
+        return EventFrame.from_events([])
+    if len(frames) == 1:
+        return frames[0]
+    import dataclasses
+
+    cols = {}
+    for fld in dataclasses.fields(EventFrame):
+        vals = [getattr(f, fld.name) for f in frames]
+        cols[fld.name] = (
+            np.concatenate(vals) if all(v is not None for v in vals) else None
+        )
+    return EventFrame(**cols)
+
+
 class PEvents(abc.ABC):
     """Bulk columnar event access — the Spark-side DAO role, TPU-native.
 
     ``find`` yields one EventFrame per shard so multi-host workers can each
     scan an entity-hash range (the HBase row-key idea, HBEventsUtil.scala:83).
     """
+
+    def n_shards(self, app_id: int, channel_id: int | None = None) -> int:
+        """Entity-hash scan-shard count of this app's layout (1 =
+        unsharded).  Part of the contract so shard-addressed consumers
+        (the storage daemon's /shards route, multi-process trainers) never
+        reach into backend internals for it."""
+        return 1
 
     @abc.abstractmethod
     def find(

@@ -4,9 +4,11 @@ Mirrors Storage.scala:158-223: sources from ``PIO_STORAGE_SOURCES_<NAME>_*``,
 repositories from ``PIO_STORAGE_REPOSITORIES_<REPO>_{NAME,SOURCE}``.
 Supported source TYPEs here: ``sqlite`` (events+metadata+models; the JDBC
 analog), ``postgres`` (same, client-server), ``parquet`` (events only — the
-entity-hash-sharded columnar log, the ES/HBase role), ``localfs`` (models
-only).  With no configuration at all, everything lives under ``$PIO_HOME``
-(default ``~/.predictionio_tpu``).
+entity-hash-sharded columnar log), ``remote`` (events+metadata+models over
+the storage daemon, server/storage_server.py — the Elasticsearch
+server-fleet role), ``localfs`` (models only), ``s3`` (models only).  With
+no configuration at all, everything lives under ``$PIO_HOME`` (default
+``~/.predictionio_tpu``).
 """
 
 from __future__ import annotations
@@ -104,6 +106,16 @@ class StorageRuntime:
         self.config = config or StorageConfig.from_env()
         self._clients: dict[str, Any] = {}
         self._lock = threading.RLock()
+        # Eagerly import the pyarrow-backed module when any source uses it.
+        # The first import of pyarrow-touching code must NOT happen inside a
+        # short-lived worker thread (e.g. an HTTP handler serving the first
+        # bulk write): arrow state initialized on a thread that then dies
+        # leaves later pa.array calls segfaulting.  Importing here pins the
+        # import to the thread that builds the runtime (process startup).
+        if any(
+            s.get("TYPE") == "parquet" for s in self.config.sources.values()
+        ):
+            from predictionio_tpu.data.storage import parquet_backend  # noqa: F401
 
     def _sql_client(self, name: str, props: dict[str, str]):
         """A SQL client for a source: sqlite (embedded) or postgres."""
@@ -135,6 +147,37 @@ class StorageRuntime:
         name, props = self.config.source_for("EVENTDATA")
         return self._sql_client(name, props)
 
+    def _remote_client(self, name: str, props: dict[str, str]):
+        """Keep-alive HTTP client for a storage-daemon source (TYPE=remote,
+        the ES/HBase server-fleet role — server/storage_server.py)."""
+        from predictionio_tpu.data.storage.remote_backend import RemoteClient
+
+        with self._lock:
+            key = f"__remote_{name}__"
+            if key not in self._clients:
+                url = props.get("URL") or props.get("HOSTS", "")
+                if not url:
+                    raise StorageError(
+                        f"remote source {name} needs PIO_STORAGE_SOURCES_"
+                        f"{name}_URL (e.g. http://host:7072)"
+                    )
+                self._clients[key] = RemoteClient(
+                    url,
+                    auth_key=props.get("AUTHKEY"),
+                    # bulk /frame scans of big apps can legitimately run
+                    # past the default; operators size this to their data
+                    timeout=float(props.get("TIMEOUT", 30.0)),
+                    verify=props.get("VERIFY", "true").lower()
+                    not in ("false", "0", "no"),
+                )
+            return self._clients[key]
+
+    def _meta_dao(self, sqlite_cls, remote_cls):
+        name, props = self.config.source_for("METADATA")
+        if props.get("TYPE") == "remote":
+            return remote_cls(self._remote_client(name, props))
+        return sqlite_cls(self._sql_client(name, props))
+
     def _parquet_client(self, name: str, props: dict[str, str]):
         from predictionio_tpu.data.storage.parquet_backend import (
             DEFAULT_N_SHARDS,
@@ -152,23 +195,39 @@ class StorageRuntime:
 
     # -- metadata DAOs -------------------------------------------------------
     def apps(self) -> base.Apps:
-        return SQLiteApps(self._meta_client())
+        from predictionio_tpu.data.storage import remote_backend as rb
+
+        return self._meta_dao(SQLiteApps, rb.RemoteApps)
 
     def access_keys(self) -> base.AccessKeys:
-        return SQLiteAccessKeys(self._meta_client())
+        from predictionio_tpu.data.storage import remote_backend as rb
+
+        return self._meta_dao(SQLiteAccessKeys, rb.RemoteAccessKeys)
 
     def channels(self) -> base.Channels:
-        return SQLiteChannels(self._meta_client())
+        from predictionio_tpu.data.storage import remote_backend as rb
+
+        return self._meta_dao(SQLiteChannels, rb.RemoteChannels)
 
     def engine_instances(self) -> base.EngineInstances:
-        return SQLiteEngineInstances(self._meta_client())
+        from predictionio_tpu.data.storage import remote_backend as rb
+
+        return self._meta_dao(SQLiteEngineInstances, rb.RemoteEngineInstances)
 
     def evaluation_instances(self) -> base.EvaluationInstances:
-        return SQLiteEvaluationInstances(self._meta_client())
+        from predictionio_tpu.data.storage import remote_backend as rb
+
+        return self._meta_dao(
+            SQLiteEvaluationInstances, rb.RemoteEvaluationInstances
+        )
 
     def models(self) -> base.Models:
         name, props = self.config.source_for("MODELDATA")
         typ = props.get("TYPE", "sqlite")
+        if typ == "remote":
+            from predictionio_tpu.data.storage.remote_backend import RemoteModels
+
+            return RemoteModels(self._remote_client(name, props))
         if typ == "localfs":
             return LocalFSModels(props.get("PATH", str(self.config.home / "models")))
         if typ == "s3":
@@ -190,13 +249,22 @@ class StorageRuntime:
         with self._lock:
             if "__levents__" not in self._clients:
                 name, props = self.config.source_for("EVENTDATA")
-                if props.get("TYPE", "sqlite") == "parquet":
+                typ = props.get("TYPE", "sqlite")
+                if typ == "parquet":
                     from predictionio_tpu.data.storage.parquet_backend import (
                         ParquetLEvents,
                     )
 
                     self._clients["__levents__"] = ParquetLEvents(
                         self._parquet_client(name, props)
+                    )
+                elif typ == "remote":
+                    from predictionio_tpu.data.storage.remote_backend import (
+                        RemoteLEvents,
+                    )
+
+                    self._clients["__levents__"] = RemoteLEvents(
+                        self._remote_client(name, props)
                     )
                 else:
                     self._clients["__levents__"] = SQLiteLEvents(
@@ -208,13 +276,22 @@ class StorageRuntime:
         with self._lock:
             if "__pevents__" not in self._clients:
                 name, props = self.config.source_for("EVENTDATA")
-                if props.get("TYPE", "sqlite") == "parquet":
+                typ = props.get("TYPE", "sqlite")
+                if typ == "parquet":
                     from predictionio_tpu.data.storage.parquet_backend import (
                         ParquetPEvents,
                     )
 
                     self._clients["__pevents__"] = ParquetPEvents(
                         self._parquet_client(name, props)
+                    )
+                elif typ == "remote":
+                    from predictionio_tpu.data.storage.remote_backend import (
+                        RemotePEvents,
+                    )
+
+                    self._clients["__pevents__"] = RemotePEvents(
+                        self._remote_client(name, props)
                     )
                 else:
                     self._clients["__pevents__"] = SQLitePEvents(

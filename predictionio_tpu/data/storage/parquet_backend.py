@@ -27,7 +27,6 @@ can each take a shard range (SURVEY §7 step 9).
 
 from __future__ import annotations
 
-import hashlib
 import json
 import shutil
 import threading
@@ -50,6 +49,7 @@ from predictionio_tpu.data.storage.base import (
     EventFrame,
     LEvents,
     PEvents,
+    entity_shard,  # canonical home is base.py (pyarrow-free); re-exported
 )
 
 DEFAULT_N_SHARDS = 16
@@ -72,12 +72,6 @@ _SCHEMA = pa.schema(
 )
 
 _TOMB_SCHEMA = pa.schema([("event_id", pa.string()), ("seq", pa.int64())])
-
-
-def entity_shard(entity_type: str, entity_id: str, n_shards: int) -> int:
-    """The HBEventsUtil.scala:83 row-key hash, reduced to a shard index."""
-    digest = hashlib.md5(f"{entity_type}-{entity_id}".encode()).digest()
-    return int.from_bytes(digest[:4], "big") % n_shards
 
 
 def _to_ms(dt: datetime) -> int:
@@ -632,6 +626,10 @@ class ParquetPEvents(PEvents):
 
     def __init__(self, client: ParquetClient):
         self.store = ParquetEventStore(client)
+
+    def n_shards(self, app_id: int, channel_id: int | None = None) -> int:
+        c = self.store.client
+        return c.n_shards(c.app_dir(app_id, channel_id))
 
     def iter_shards(
         self,

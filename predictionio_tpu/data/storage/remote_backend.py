@@ -1,0 +1,745 @@
+"""Remote storage backend — DAO clients that talk to the storage daemon.
+
+The reference's Elasticsearch source implements every DAO trait against a
+remote REST server (storage/elasticsearch/.../ESLEvents.scala:41,
+ESApps.scala, ESEngineInstances.scala, ESPEvents.scala:42) so one storage
+fleet serves all processes.  This module is that role for the TPU
+framework: ``Remote*`` classes implement the exact contracts in
+``data/storage/base.py`` over HTTP against ``server/storage_server.py``.
+
+Configure with::
+
+    PIO_STORAGE_SOURCES_REMOTE_TYPE=remote
+    PIO_STORAGE_SOURCES_REMOTE_URL=http://storage-host:7072
+    PIO_STORAGE_SOURCES_REMOTE_AUTHKEY=...          # optional
+    PIO_STORAGE_SOURCES_REMOTE_TIMEOUT=120          # seconds, default 30
+    PIO_STORAGE_SOURCES_REMOTE_VERIFY=false         # TLS verify, default on
+    PIO_STORAGE_REPOSITORIES_{METADATA,EVENTDATA,MODELDATA}_SOURCE=REMOTE
+
+Bulk scans (the PEvents side) move as the PIOF1 binary columnar frame
+(``frame_codec.py``), shard-addressed so multi-host trainers fetch
+disjoint entity-hash ranges — the remote flavor of
+``ParquetPEvents.iter_shards``.
+
+Connections are keep-alive ``http.client`` handles, one per thread (the
+serving hot path is threaded); a stale-connection retry covers daemon
+restarts and keep-alive timeouts.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import ssl
+import threading
+import time
+
+import numpy as np
+from datetime import datetime
+from typing import Any, Iterator, Sequence
+from urllib.parse import quote, urlencode, urlsplit
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.base import EventFilter, EventFrame
+from predictionio_tpu.data.storage.frame_codec import decode_frame, encode_frame
+
+
+class RemoteStorageError(Exception):
+    """Transport- or server-side failure from the storage daemon."""
+
+
+# ---------------------------------------------------------------------------
+# Wire codecs shared with the daemon (server/storage_server.py imports these
+# so the format is defined exactly once)
+# ---------------------------------------------------------------------------
+
+_INSTANCE_MS = ("start_time", "end_time")
+
+
+def _ms_to_dt(ms: int) -> datetime:
+    from datetime import timezone
+
+    return datetime.fromtimestamp(ms / 1000.0, tz=timezone.utc)
+
+
+def _inst_to_dict(i) -> dict:
+    import dataclasses
+
+    d = dataclasses.asdict(i)
+    for k in _INSTANCE_MS:
+        d[k] = int(d[k].timestamp() * 1000)
+    return d
+
+
+def engine_instance_to_dict(i: base.EngineInstance) -> dict:
+    return _inst_to_dict(i)
+
+
+def engine_instance_from_dict(d: dict) -> base.EngineInstance:
+    d = dict(d)
+    for k in _INSTANCE_MS:
+        d[k] = _ms_to_dt(d[k])
+    return base.EngineInstance(**d)
+
+
+def evaluation_instance_to_dict(i: base.EvaluationInstance) -> dict:
+    return _inst_to_dict(i)
+
+
+def evaluation_instance_from_dict(d: dict) -> base.EvaluationInstance:
+    d = dict(d)
+    for k in _INSTANCE_MS:
+        d[k] = _ms_to_dt(d[k])
+    return base.EvaluationInstance(**d)
+
+
+def filter_from_dict(d: dict | None) -> EventFilter | None:
+    """Inverse of ``filter_to_dict`` (used by the daemon)."""
+    if not d:
+        return None
+    return EventFilter(
+        start_time=_ms_to_dt(d["startMs"]) if "startMs" in d else None,
+        until_time=_ms_to_dt(d["untilMs"]) if "untilMs" in d else None,
+        entity_type=d.get("entityType"),
+        entity_id=d.get("entityId"),
+        event_names=tuple(d["eventNames"]) if "eventNames" in d else None,
+        target_entity_type=d.get("targetEntityType"),
+        target_entity_id=d.get("targetEntityId"),
+        limit=d.get("limit"),
+        reversed=d.get("reversed", False),
+    )
+
+
+def filter_to_dict(f: EventFilter | None) -> dict | None:
+    """Wire encoding of the find() filter algebra.  None-valued fields are
+    omitted so "" (match events with NO target) survives the trip."""
+    if f is None:
+        return None
+    d: dict[str, Any] = {}
+    if f.start_time is not None:
+        d["startMs"] = int(f.start_time.timestamp() * 1000)
+    if f.until_time is not None:
+        d["untilMs"] = int(f.until_time.timestamp() * 1000)
+    if f.entity_type is not None:
+        d["entityType"] = f.entity_type
+    if f.entity_id is not None:
+        d["entityId"] = f.entity_id
+    if f.event_names is not None:
+        d["eventNames"] = list(f.event_names)
+    if f.target_entity_type is not None:
+        d["targetEntityType"] = f.target_entity_type
+    if f.target_entity_id is not None:
+        d["targetEntityId"] = f.target_entity_id
+    if f.limit is not None:
+        d["limit"] = f.limit
+    if f.reversed:
+        d["reversed"] = True
+    return d or None
+
+
+#: default replay policy by method when the caller does not declare one:
+#: POST is excluded because a blind replay can duplicate server-minted rows;
+#: POST call sites that ARE replay-safe (id-carrying upserts) opt in via
+#: ``idempotent=True``.
+_IDEMPOTENT = frozenset({"GET", "PUT", "DELETE"})
+
+
+class RemoteClient:
+    """Thread-local keep-alive HTTP client for the storage daemon.
+
+    TLS certificate verification is ON by default; pass ``verify=False``
+    (PIO_STORAGE_SOURCES_<name>_VERIFY=false) only for self-signed dev
+    certs — with it off, an on-path attacker can read the access key and
+    all stored data.
+    """
+
+    def __init__(
+        self,
+        url: str,
+        auth_key: str | None = None,
+        timeout: float = 30.0,
+        verify: bool = True,
+    ):
+        parts = urlsplit(url)
+        if parts.scheme not in ("http", "https"):
+            raise ValueError(f"storage daemon URL must be http(s): {url!r}")
+        self.scheme = parts.scheme
+        self.host = parts.hostname or "127.0.0.1"
+        self.port = parts.port or (443 if self.scheme == "https" else 7072)
+        self.auth_key = auth_key
+        self.timeout = timeout
+        self.verify = verify
+        self._local = threading.local()
+
+    def _connection(self) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            if self.scheme == "https":
+                ctx = (
+                    ssl.create_default_context()
+                    if self.verify
+                    else ssl._create_unverified_context()
+                )
+                conn = http.client.HTTPSConnection(
+                    self.host, self.port, timeout=self.timeout, context=ctx
+                )
+            else:
+                conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=self.timeout
+                )
+            self._local.conn = conn
+            self._local.last_used = time.monotonic()
+        return conn
+
+    def _drop_connection(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            self._local.conn = None
+
+    #: drop a keep-alive connection idle longer than this before reuse —
+    #: shrinks the window where the daemon's idle-close races our send
+    _MAX_IDLE_S = 10.0
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        params: dict | None = None,
+        body: bytes | None = None,
+        content_type: str = "application/json",
+        idempotent: bool | None = None,
+    ) -> tuple[int, bytes]:
+        """One HTTP round trip.  ``idempotent`` declares whether a REPLAY of
+        this exact request is safe (server upserts / overwrite semantics);
+        None falls back to the method class (_IDEMPOTENT).  Replays happen
+        at most once, and only when the response was lost after a full
+        send; send-phase failures retry regardless (the daemon never saw a
+        complete framed request)."""
+        q = dict(params or {})
+        if self.auth_key is not None:
+            q["accessKey"] = self.auth_key
+        if q:
+            path = f"{path}?{urlencode(q)}"
+        headers = {"Content-Type": content_type} if body is not None else {}
+        if idempotent is None:
+            idempotent = method in _IDEMPOTENT
+        _net_errors = (
+            http.client.HTTPException,
+            ConnectionError,
+            BrokenPipeError,
+            TimeoutError,
+            OSError,
+        )
+        if (
+            getattr(self._local, "conn", None) is not None
+            and time.monotonic() - getattr(self._local, "last_used", 0.0)
+            > self._MAX_IDLE_S
+        ):
+            self._drop_connection()
+        for attempt in (0, 1):
+            conn = self._connection()
+            # Send phase.  A failure here (connect refused, pipe broken
+            # mid-send) means the daemon never saw a complete framed
+            # request, so ONE retry is safe for every method.
+            try:
+                conn.request(method, path, body=body, headers=headers)
+            except _net_errors as e:
+                self._drop_connection()
+                if attempt:
+                    raise RemoteStorageError(
+                        f"storage daemon unreachable at "
+                        f"{self.scheme}://{self.host}:{self.port}: {e}"
+                    ) from e
+                continue
+            # Response phase.  The request was fully sent; the daemon may
+            # have processed it even though the response was lost, so only
+            # declared-idempotent requests may replay.  Non-idempotent
+            # requests fail loudly — callers that need replay safety make
+            # themselves idempotent (event inserts mint ids client-side so
+            # a replay upserts).
+            try:
+                resp = conn.getresponse()
+                status, data = resp.status, resp.read()
+                self._local.last_used = time.monotonic()
+                return status, data
+            except _net_errors as e:
+                self._drop_connection()
+                if attempt or not idempotent:
+                    raise RemoteStorageError(
+                        f"{method} {path.split('?')[0]} to storage daemon "
+                        f"failed after send: {e}"
+                    ) from e
+        raise AssertionError("unreachable")
+
+    def json(
+        self,
+        method: str,
+        path: str,
+        params: dict | None = None,
+        payload: Any = None,
+        ok_404: bool = False,
+        idempotent: bool | None = None,
+    ) -> Any:
+        body = (
+            json.dumps(payload).encode("utf-8") if payload is not None else None
+        )
+        status, raw = self.request(
+            method, path, params, body, idempotent=idempotent
+        )
+        if status == 404 and ok_404:
+            return None
+        if status >= 400:
+            raise RemoteStorageError(
+                f"{method} {path} -> {status}: {raw[:200].decode('utf-8', 'replace')}"
+            )
+        return json.loads(raw) if raw else None
+
+    def close(self) -> None:
+        self._drop_connection()
+
+
+# ---------------------------------------------------------------------------
+# Metadata DAOs
+# ---------------------------------------------------------------------------
+
+
+class RemoteApps(base.Apps):
+    def __init__(self, client: RemoteClient):
+        self.client = client
+
+    def insert(self, app: base.App) -> int | None:
+        # a duplicate name comes back in-band as {"id": null}; transport and
+        # auth failures must surface, not masquerade as "duplicate".  Not
+        # replay-safe: the server mints the id row.
+        return self.client.json(
+            "POST",
+            "/v1/apps",
+            payload={"id": app.id, "name": app.name, "description": app.description},
+            idempotent=False,
+        )["id"]
+
+    def get(self, app_id: int) -> base.App | None:
+        d = self.client.json("GET", f"/v1/apps/id/{app_id}", ok_404=True)
+        return base.App(**d) if d else None
+
+    def get_by_name(self, name: str) -> base.App | None:
+        d = self.client.json("GET", f"/v1/apps/name/{quote(name, safe='')}", ok_404=True)
+        return base.App(**d) if d else None
+
+    def get_all(self) -> list[base.App]:
+        return [base.App(**d) for d in self.client.json("GET", "/v1/apps")]
+
+    def update(self, app: base.App) -> bool:
+        return self.client.json(
+            "PUT",
+            f"/v1/apps/id/{app.id}",
+            payload={"name": app.name, "description": app.description},
+        )["ok"]
+
+    def delete(self, app_id: int) -> bool:
+        return self.client.json("DELETE", f"/v1/apps/id/{app_id}")["ok"]
+
+
+class RemoteAccessKeys(base.AccessKeys):
+    def __init__(self, client: RemoteClient):
+        self.client = client
+
+    @staticmethod
+    def _parse(d: dict) -> base.AccessKey:
+        return base.AccessKey(
+            key=d["key"], appid=d["appid"], events=tuple(d.get("events", ()))
+        )
+
+    def insert(self, k: base.AccessKey) -> str | None:
+        return self.client.json(
+            "POST",
+            "/v1/accesskeys",
+            payload={"key": k.key, "appid": k.appid, "events": list(k.events)},
+            # never replayed: the server's key insert is a plain INSERT
+            # (duplicate -> null), so a replay of a committed insert would
+            # misreport success as a duplicate failure; an empty key would
+            # even mint a second key row
+            idempotent=False,
+        )["key"]
+
+    def get(self, key: str) -> base.AccessKey | None:
+        d = self.client.json("GET", f"/v1/accesskeys/{quote(key, safe='')}", ok_404=True)
+        return self._parse(d) if d else None
+
+    def get_by_appid(self, appid: int) -> list[base.AccessKey]:
+        rows = self.client.json("GET", "/v1/accesskeys", params={"appid": appid})
+        return [self._parse(d) for d in rows]
+
+    def get_all(self) -> list[base.AccessKey]:
+        return [self._parse(d) for d in self.client.json("GET", "/v1/accesskeys")]
+
+    def update(self, k: base.AccessKey) -> bool:
+        return self.client.json(
+            "PUT",
+            f"/v1/accesskeys/{quote(k.key, safe='')}",
+            payload={"appid": k.appid, "events": list(k.events)},
+        )["ok"]
+
+    def delete(self, key: str) -> bool:
+        return self.client.json("DELETE", f"/v1/accesskeys/{quote(key, safe='')}")["ok"]
+
+
+class RemoteChannels(base.Channels):
+    def __init__(self, client: RemoteClient):
+        self.client = client
+
+    def insert(self, channel: base.Channel) -> int | None:
+        return self.client.json(
+            "POST",
+            "/v1/channels",
+            payload={
+                "id": channel.id,
+                "name": channel.name,
+                "appid": channel.appid,
+            },
+            idempotent=False,
+        )["id"]
+
+    def get(self, channel_id: int) -> base.Channel | None:
+        d = self.client.json("GET", f"/v1/channels/{channel_id}", ok_404=True)
+        return base.Channel(**d) if d else None
+
+    def get_by_appid(self, appid: int) -> list[base.Channel]:
+        rows = self.client.json("GET", "/v1/channels", params={"appid": appid})
+        return [base.Channel(**d) for d in rows]
+
+    def delete(self, channel_id: int) -> bool:
+        return self.client.json("DELETE", f"/v1/channels/{channel_id}")["ok"]
+
+
+class RemoteEngineInstances(base.EngineInstances):
+    def __init__(self, client: RemoteClient):
+        self.client = client
+        self._enc, self._dec = engine_instance_to_dict, engine_instance_from_dict
+
+    def insert(self, i: base.EngineInstance) -> str:
+        return self.client.json(
+            "POST",
+            "/v1/engine_instances",
+            payload=self._enc(i),
+            idempotent=bool(i.id),  # caller-supplied id -> server upserts
+        )["id"]
+
+    def get(self, instance_id: str) -> base.EngineInstance | None:
+        d = self.client.json(
+            "GET", f"/v1/engine_instances/{quote(instance_id, safe='')}", ok_404=True
+        )
+        return self._dec(d) if d else None
+
+    def get_all(self) -> list[base.EngineInstance]:
+        return [
+            self._dec(d) for d in self.client.json("GET", "/v1/engine_instances")
+        ]
+
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> base.EngineInstance | None:
+        rows = self.client.json(
+            "GET",
+            "/v1/engine_instances",
+            params={
+                "engine_id": engine_id,
+                "engine_version": engine_version,
+                "engine_variant": engine_variant,
+                "latest": 1,
+            },
+        )
+        return self._dec(rows[0]) if rows else None
+
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> list[base.EngineInstance]:
+        rows = self.client.json(
+            "GET",
+            "/v1/engine_instances",
+            params={
+                "engine_id": engine_id,
+                "engine_version": engine_version,
+                "engine_variant": engine_variant,
+            },
+        )
+        return [self._dec(d) for d in rows]
+
+    def update(self, i: base.EngineInstance) -> bool:
+        return self.client.json(
+            "PUT", f"/v1/engine_instances/{quote(i.id, safe='')}", payload=self._enc(i)
+        )["ok"]
+
+    def delete(self, instance_id: str) -> bool:
+        return self.client.json(
+            "DELETE", f"/v1/engine_instances/{quote(instance_id, safe='')}"
+        )["ok"]
+
+
+class RemoteEvaluationInstances(base.EvaluationInstances):
+    def __init__(self, client: RemoteClient):
+        self.client = client
+        self._enc, self._dec = (
+            evaluation_instance_to_dict,
+            evaluation_instance_from_dict,
+        )
+
+    def insert(self, i: base.EvaluationInstance) -> str:
+        return self.client.json(
+            "POST",
+            "/v1/evaluation_instances",
+            payload=self._enc(i),
+            idempotent=bool(i.id),
+        )["id"]
+
+    def get(self, instance_id: str) -> base.EvaluationInstance | None:
+        d = self.client.json(
+            "GET", f"/v1/evaluation_instances/{quote(instance_id, safe='')}", ok_404=True
+        )
+        return self._dec(d) if d else None
+
+    def get_all(self) -> list[base.EvaluationInstance]:
+        return [
+            self._dec(d)
+            for d in self.client.json("GET", "/v1/evaluation_instances")
+        ]
+
+    def get_completed(self) -> list[base.EvaluationInstance]:
+        rows = self.client.json(
+            "GET", "/v1/evaluation_instances", params={"completed": 1}
+        )
+        return [self._dec(d) for d in rows]
+
+    def update(self, i: base.EvaluationInstance) -> bool:
+        return self.client.json(
+            "PUT", f"/v1/evaluation_instances/{quote(i.id, safe='')}", payload=self._enc(i)
+        )["ok"]
+
+    def delete(self, instance_id: str) -> bool:
+        return self.client.json(
+            "DELETE", f"/v1/evaluation_instances/{quote(instance_id, safe='')}"
+        )["ok"]
+
+
+class RemoteModels(base.Models):
+    """Blob store over the daemon; the multipart (sharded-checkpoint)
+    layout rides the base-class keyed-blob mapping, so every part is one
+    PUT — the HDFS/S3 remote-model-store role (HDFSModels.scala:31)."""
+
+    def __init__(self, client: RemoteClient):
+        self.client = client
+
+    def insert(self, instance_id: str, blob: bytes) -> None:
+        status, raw = self.client.request(
+            "PUT",
+            f"/v1/models/{quote(instance_id, safe='')}",
+            body=blob,
+            content_type="application/octet-stream",
+        )
+        if status >= 400:
+            raise RemoteStorageError(f"model PUT -> {status}")
+
+    def get(self, instance_id: str) -> bytes | None:
+        status, raw = self.client.request("GET", f"/v1/models/{quote(instance_id, safe='')}")
+        if status == 404:
+            return None
+        if status >= 400:
+            raise RemoteStorageError(f"model GET -> {status}")
+        return raw
+
+    def delete(self, instance_id: str) -> bool:
+        return self.client.json("DELETE", f"/v1/models/{quote(instance_id, safe='')}")["ok"]
+
+
+# ---------------------------------------------------------------------------
+# Event DAOs
+# ---------------------------------------------------------------------------
+
+
+def _chan_params(channel_id: int | None, extra: dict | None = None) -> dict:
+    p = dict(extra or {})
+    if channel_id is not None:
+        p["channel"] = channel_id
+    return p
+
+
+def _filter_params(
+    channel_id: int | None, filter: EventFilter | None
+) -> dict:
+    p = _chan_params(channel_id)
+    d = filter_to_dict(filter)
+    if d:
+        p["filter"] = json.dumps(d, separators=(",", ":"))
+    return p
+
+
+class RemoteLEvents(base.LEvents):
+    def __init__(self, client: RemoteClient):
+        self.client = client
+
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        return self.client.json(
+            "POST", f"/v1/apps/{app_id}/init", params=_chan_params(channel_id)
+        )["ok"]
+
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        return self.client.json(
+            "POST", f"/v1/apps/{app_id}/remove", params=_chan_params(channel_id)
+        )["ok"]
+
+    def close(self) -> None:
+        self.client.close()
+
+    def insert(
+        self, event: Event, app_id: int, channel_id: int | None = None
+    ) -> str:
+        return self.insert_batch([event], app_id, channel_id)[0]
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: int | None = None
+    ) -> list[str]:
+        # Mint event ids CLIENT-side (the LEvents contract makes inserts
+        # with an id upsert), so this POST is replay-safe: if the response
+        # is lost after the daemon committed, the retry writes the same
+        # rows instead of duplicating them under fresh server ids.
+        events = [e if e.event_id else e.with_id() for e in events]
+        return self.client.json(
+            "POST",
+            f"/v1/apps/{app_id}/events",
+            params=_chan_params(channel_id),
+            payload=[e.to_api_dict() for e in events],
+            idempotent=True,
+        )["ids"]
+
+    def get(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> Event | None:
+        d = self.client.json(
+            "GET",
+            f"/v1/apps/{app_id}/events/{quote(event_id, safe='')}",
+            params=_chan_params(channel_id),
+            ok_404=True,
+        )
+        return Event.from_api_dict(d) if d else None
+
+    def delete(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> bool:
+        return self.client.json(
+            "DELETE",
+            f"/v1/apps/{app_id}/events/{quote(event_id, safe='')}",
+            params=_chan_params(channel_id),
+        )["ok"]
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        filter: EventFilter | None = None,
+    ) -> Iterator[Event]:
+        rows = self.client.json(
+            "GET",
+            f"/v1/apps/{app_id}/events",
+            params=_filter_params(channel_id, filter),
+        )
+        return iter([Event.from_api_dict(d) for d in rows])
+
+
+class RemotePEvents(base.PEvents):
+    def __init__(self, client: RemoteClient):
+        self.client = client
+
+    def n_shards(self, app_id: int, channel_id: int | None = None) -> int:
+        return self.client.json(
+            "GET",
+            f"/v1/apps/{app_id}/shards",
+            params=_chan_params(channel_id),
+        )["n_shards"]
+
+    def _fetch_frame(self, app_id: int, params: dict) -> EventFrame:
+        status, raw = self.client.request(
+            "GET", f"/v1/apps/{app_id}/frame", params=params
+        )
+        if status >= 400:
+            raise RemoteStorageError(f"frame scan -> {status}")
+        return decode_frame(raw)
+
+    def iter_shards(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        filter: EventFilter | None = None,
+        shards: Sequence[int] | None = None,
+    ) -> Iterator[tuple[int, EventFrame]]:
+        """Shard-addressed bulk scan.  The whole requested shard set moves
+        in ONE grouped fetch (SQL-backed daemons split a single table scan
+        host-side, so per-shard requests would cost one full scan each) and
+        is re-split locally by the same entity-hash function the layouts
+        use.  Callers needing memory-bounded streaming can pass singleton
+        ``shards`` lists per call."""
+        from predictionio_tpu.data.storage.base import entity_shard
+
+        if shards is not None and len(shards) == 1:
+            # singleton fast path: no /shards round trip, no local re-split
+            k = list(shards)[0]
+            yield k, self._fetch_frame(
+                app_id, _filter_params(channel_id, filter) | {"shards": k}
+            )
+            return
+        n = self.n_shards(app_id, channel_id)
+        want = list(shards) if shards is not None else list(range(n))
+        frame = self._fetch_frame(
+            app_id,
+            _filter_params(channel_id, filter)
+            | {"shards": ",".join(str(k) for k in want)},
+        )
+        shard_of = np.fromiter(
+            (
+                entity_shard(t, e, n)
+                for t, e in zip(frame.entity_type, frame.entity_id)
+            ),
+            np.int64,
+            len(frame),
+        )
+        for k in want:
+            yield k, frame.take(shard_of == k)
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        filter: EventFilter | None = None,
+    ) -> EventFrame:
+        return self._fetch_frame(app_id, _filter_params(channel_id, filter))
+
+    def write(
+        self, frame: EventFrame, app_id: int, channel_id: int | None = None
+    ) -> None:
+        replay_safe = frame.event_id is not None and not any(
+            v is None for v in frame.event_id
+        )  # id-carrying rows upsert on replay; id-less rows would duplicate
+        status, _ = self.client.request(
+            "POST",
+            f"/v1/apps/{app_id}/frame",
+            params=_chan_params(channel_id),
+            body=encode_frame(frame),
+            content_type="application/x-pio-frame",
+            idempotent=replay_safe,
+        )
+        if status >= 400:
+            raise RemoteStorageError(f"frame write -> {status}")
+
+    def delete(
+        self, event_ids: Sequence[str], app_id: int, channel_id: int | None = None
+    ) -> None:
+        self.client.json(
+            "POST",
+            f"/v1/apps/{app_id}/frame_delete",
+            params=_chan_params(channel_id),
+            payload={"ids": list(event_ids)},
+        )

@@ -335,6 +335,9 @@ class SQLitePEvents(base.PEvents):
     #: default logical shard count for multi-process scans
     N_SCAN_SHARDS = 8
 
+    def n_shards(self, app_id: int, channel_id: int | None = None) -> int:
+        return self.N_SCAN_SHARDS
+
     def _shard_expr(self, n_shards: int) -> str | None:
         """SQL expression computing the entity-hash shard of a row, or None
         when the dialect can't (scan once + split on the host instead).
@@ -357,7 +360,7 @@ class SQLitePEvents(base.PEvents):
         Server dialects that can hash in SQL (Postgres) filter rows
         server-side, so each process only transfers its own shards.
         """
-        from predictionio_tpu.data.storage.parquet_backend import entity_shard
+        from predictionio_tpu.data.storage.base import entity_shard
 
         n = n_shards or self.N_SCAN_SHARDS
         want = list(range(n)) if shards is None else list(shards)

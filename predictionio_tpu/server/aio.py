@@ -22,13 +22,14 @@ import os
 import ssl as ssl_mod
 import threading
 from typing import Any
-from urllib.parse import parse_qs, unquote, urlsplit
+from urllib.parse import parse_qs, urlsplit
 
 from predictionio_tpu.server.httpd import (
     HTTPApp,
     Request,
     Response,
     error_response,
+    unquote_groups,
 )
 
 log = logging.getLogger("predictionio_tpu.aio")
@@ -48,7 +49,7 @@ async def _handle_app_request(app: HTTPApp, req: Request) -> Response:
         path_matched = True
         if method != req.method:
             continue
-        req.params = m.groupdict()
+        req.params = unquote_groups(m)
         try:
             if inspect.iscoroutinefunction(fn):
                 return await fn(req)
@@ -91,8 +92,6 @@ async def _read_request(reader: asyncio.StreamReader) -> Request | None:
         path, query = split.path, {k: v[0] for k, v in q.items()}
     else:  # hot path: no query string to parse
         path, query = target, {}
-    if "%" in path:
-        path = unquote(path)
     return Request(
         method=method.upper(),
         path=path,

@@ -65,6 +65,16 @@ class Response:
 Handler = Callable[[Request], Response]
 
 
+def unquote_groups(m: re.Match) -> dict[str, str]:
+    """Percent-decode captured route params AFTER matching.  Matching runs
+    on the still-quoted path so a value containing an encoded '/' (%2F)
+    stays one segment — unquoting first would turn it into a path
+    separator and 404 every [^/]+ route for such names."""
+    return {
+        k: (unquote(v) if v is not None else v) for k, v in m.groupdict().items()
+    }
+
+
 def json_response(status: int, body: Any) -> Response:
     return Response(status=status, body=body)
 
@@ -113,7 +123,7 @@ class HTTPApp:
             path_matched = True
             if method != req.method:
                 continue
-            req.params = m.groupdict()
+            req.params = unquote_groups(m)
             try:
                 return fn(req)
             except Exception as e:  # the exceptionHandler analog
@@ -135,7 +145,7 @@ def _make_handler_class(app: HTTPApp):
             body = self.rfile.read(length) if length else b""
             req = Request(
                 method=method,
-                path=unquote(split.path),
+                path=split.path,
                 query={k: v[0] for k, v in q.items()},
                 headers=self.headers,
                 body=body,

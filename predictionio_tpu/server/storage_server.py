@@ -1,0 +1,428 @@
+"""Remote storage daemon — the server-grade networked storage backend.
+
+The reference's production deployments point all three repositories at
+networked stores: Elasticsearch serves metadata + events
+(storage/elasticsearch/.../ESLEvents.scala:41, ESPEvents.scala:42 — a REST
+server owning the data, many client processes), HBase serves events, HDFS
+serves models.  This daemon is the TPU-native analog of that *role*: one
+process owns the storage root (sqlite metadata + entity-hash-sharded
+parquet event log + blob model store) and exposes every DAO contract from
+``data/storage/base.py`` over HTTP, so any number of trainer / event-server
+/ prediction-server processes on other hosts share one storage service.
+
+Wire protocol: JSON for metadata and row-at-a-time events (the LEvents
+side), the PIOF1 binary columnar codec (``data/storage/frame_codec.py``)
+for bulk EventFrame scans (the PEvents side) — shard-addressable so
+multi-host trainers can each pull their entity-hash range exactly like
+``ParquetPEvents.iter_shards`` does locally (the HBEventsUtil.scala:83
+row-key partitioning idea, served remotely).
+
+Auth mirrors the dashboard/admin model (KeyAuthentication.scala:33): one
+access key gates every route when configured.  TLS comes from AppServer's
+PIO_SSL_CERTFILE/KEYFILE support.
+
+Start via ``pio storageserver --port 7072 --root /data/pio`` or embed with
+``create_storage_app`` / ``StorageServer`` (tests run it in-process).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+
+from predictionio_tpu.data.event import Event, EventValidationError
+from predictionio_tpu.data.storage import base
+from predictionio_tpu.data.storage.config import StorageConfig, StorageRuntime
+from predictionio_tpu.data.storage.base import concat_frames as _concat_frames
+from predictionio_tpu.data.storage.frame_codec import decode_frame, encode_frame
+from predictionio_tpu.data.storage.remote_backend import (
+    engine_instance_from_dict,
+    engine_instance_to_dict,
+    evaluation_instance_from_dict,
+    evaluation_instance_to_dict,
+    filter_from_dict,
+)
+from predictionio_tpu.server.httpd import (
+    AppServer,
+    HTTPApp,
+    Request,
+    Response,
+    error_response,
+    json_response,
+)
+
+
+def _req_filter(req: Request) -> base.EventFilter | None:
+    raw = req.query.get("filter")
+    return filter_from_dict(json.loads(raw)) if raw else None
+
+
+def _chan(req: Request) -> int | None:
+    c = req.query.get("channel")
+    return int(c) if c else None
+
+
+# ---------------------------------------------------------------------------
+# The app
+# ---------------------------------------------------------------------------
+
+
+def create_storage_app(
+    runtime: StorageRuntime, access_key: str | None = None
+) -> HTTPApp:
+    app = HTTPApp("storage-server", access_key=access_key)
+    rt = runtime
+
+    @app.route("GET", r"/v1/ping")
+    def ping(req: Request) -> Response:
+        return json_response(200, {"status": "alive", "service": "storage"})
+
+    # -- apps ----------------------------------------------------------------
+    @app.route("POST", r"/v1/apps")
+    def apps_insert(req: Request) -> Response:
+        d = req.json()
+        new_id = rt.apps().insert(
+            base.App(
+                id=int(d.get("id", 0)),
+                name=d["name"],
+                description=d.get("description"),
+            )
+        )
+        return json_response(200, {"id": new_id})
+
+    @app.route("GET", r"/v1/apps")
+    def apps_all(req: Request) -> Response:
+        return json_response(
+            200, [dataclasses.asdict(a) for a in rt.apps().get_all()]
+        )
+
+    @app.route("GET", r"/v1/apps/id/(?P<id>\d+)")
+    def apps_get(req: Request) -> Response:
+        a = rt.apps().get(int(req.params["id"]))
+        if a is None:
+            return error_response(404, "app not found")
+        return json_response(200, dataclasses.asdict(a))
+
+    @app.route("GET", r"/v1/apps/name/(?P<name>[^/]+)")
+    def apps_get_by_name(req: Request) -> Response:
+        a = rt.apps().get_by_name(req.params["name"])
+        if a is None:
+            return error_response(404, "app not found")
+        return json_response(200, dataclasses.asdict(a))
+
+    @app.route("PUT", r"/v1/apps/id/(?P<id>\d+)")
+    def apps_update(req: Request) -> Response:
+        d = req.json()
+        ok = rt.apps().update(
+            base.App(
+                id=int(req.params["id"]),
+                name=d["name"],
+                description=d.get("description"),
+            )
+        )
+        return json_response(200, {"ok": ok})
+
+    @app.route("DELETE", r"/v1/apps/id/(?P<id>\d+)")
+    def apps_delete(req: Request) -> Response:
+        return json_response(200, {"ok": rt.apps().delete(int(req.params["id"]))})
+
+    # -- access keys ---------------------------------------------------------
+    @app.route("POST", r"/v1/accesskeys")
+    def keys_insert(req: Request) -> Response:
+        d = req.json()
+        key = rt.access_keys().insert(
+            base.AccessKey(
+                key=d.get("key", ""),
+                appid=int(d["appid"]),
+                events=tuple(d.get("events", ())),
+            )
+        )
+        return json_response(200, {"key": key})
+
+    @app.route("GET", r"/v1/accesskeys")
+    def keys_all(req: Request) -> Response:
+        appid = req.query.get("appid")
+        keys = (
+            rt.access_keys().get_by_appid(int(appid))
+            if appid
+            else rt.access_keys().get_all()
+        )
+        return json_response(
+            200,
+            [
+                {"key": k.key, "appid": k.appid, "events": list(k.events)}
+                for k in keys
+            ],
+        )
+
+    @app.route("GET", r"/v1/accesskeys/(?P<key>[^/]+)")
+    def keys_get(req: Request) -> Response:
+        k = rt.access_keys().get(req.params["key"])
+        if k is None:
+            return error_response(404, "access key not found")
+        return json_response(
+            200, {"key": k.key, "appid": k.appid, "events": list(k.events)}
+        )
+
+    @app.route("PUT", r"/v1/accesskeys/(?P<key>[^/]+)")
+    def keys_update(req: Request) -> Response:
+        d = req.json()
+        ok = rt.access_keys().update(
+            base.AccessKey(
+                key=req.params["key"],
+                appid=int(d["appid"]),
+                events=tuple(d.get("events", ())),
+            )
+        )
+        return json_response(200, {"ok": ok})
+
+    @app.route("DELETE", r"/v1/accesskeys/(?P<key>[^/]+)")
+    def keys_delete(req: Request) -> Response:
+        return json_response(200, {"ok": rt.access_keys().delete(req.params["key"])})
+
+    # -- channels ------------------------------------------------------------
+    @app.route("POST", r"/v1/channels")
+    def chan_insert(req: Request) -> Response:
+        d = req.json()
+        try:
+            ch = base.Channel(
+                id=int(d.get("id", 0)), name=d["name"], appid=int(d["appid"])
+            )
+        except ValueError as e:
+            return error_response(400, str(e))
+        return json_response(200, {"id": rt.channels().insert(ch)})
+
+    @app.route("GET", r"/v1/channels")
+    def chan_by_app(req: Request) -> Response:
+        chans = rt.channels().get_by_appid(int(req.query.get("appid", 0)))
+        return json_response(200, [dataclasses.asdict(c) for c in chans])
+
+    @app.route("GET", r"/v1/channels/(?P<id>\d+)")
+    def chan_get(req: Request) -> Response:
+        c = rt.channels().get(int(req.params["id"]))
+        if c is None:
+            return error_response(404, "channel not found")
+        return json_response(200, dataclasses.asdict(c))
+
+    @app.route("DELETE", r"/v1/channels/(?P<id>\d+)")
+    def chan_delete(req: Request) -> Response:
+        return json_response(
+            200, {"ok": rt.channels().delete(int(req.params["id"]))}
+        )
+
+    # -- engine / evaluation instances --------------------------------------
+    @app.route("POST", r"/v1/engine_instances")
+    def ei_insert(req: Request) -> Response:
+        i = engine_instance_from_dict(req.json())
+        return json_response(200, {"id": rt.engine_instances().insert(i)})
+
+    @app.route("GET", r"/v1/engine_instances")
+    def ei_query(req: Request) -> Response:
+        q = req.query
+        dao = rt.engine_instances()
+        if "engine_id" in q:
+            args = (q["engine_id"], q.get("engine_version", ""), q.get("engine_variant", ""))
+            if q.get("latest"):
+                i = dao.get_latest_completed(*args)
+                return json_response(
+                    200, [engine_instance_to_dict(i)] if i else []
+                )
+            rows = dao.get_completed(*args)
+        else:
+            rows = dao.get_all()
+        return json_response(200, [engine_instance_to_dict(i) for i in rows])
+
+    @app.route("GET", r"/v1/engine_instances/(?P<id>[^/]+)")
+    def ei_get(req: Request) -> Response:
+        i = rt.engine_instances().get(req.params["id"])
+        if i is None:
+            return error_response(404, "engine instance not found")
+        return json_response(200, engine_instance_to_dict(i))
+
+    @app.route("PUT", r"/v1/engine_instances/(?P<id>[^/]+)")
+    def ei_update(req: Request) -> Response:
+        i = engine_instance_from_dict(req.json())
+        return json_response(200, {"ok": rt.engine_instances().update(i)})
+
+    @app.route("DELETE", r"/v1/engine_instances/(?P<id>[^/]+)")
+    def ei_delete(req: Request) -> Response:
+        return json_response(
+            200, {"ok": rt.engine_instances().delete(req.params["id"])}
+        )
+
+    @app.route("POST", r"/v1/evaluation_instances")
+    def vi_insert(req: Request) -> Response:
+        i = evaluation_instance_from_dict(req.json())
+        return json_response(200, {"id": rt.evaluation_instances().insert(i)})
+
+    @app.route("GET", r"/v1/evaluation_instances")
+    def vi_query(req: Request) -> Response:
+        dao = rt.evaluation_instances()
+        rows = dao.get_completed() if req.query.get("completed") else dao.get_all()
+        return json_response(200, [evaluation_instance_to_dict(i) for i in rows])
+
+    @app.route("GET", r"/v1/evaluation_instances/(?P<id>[^/]+)")
+    def vi_get(req: Request) -> Response:
+        i = rt.evaluation_instances().get(req.params["id"])
+        if i is None:
+            return error_response(404, "evaluation instance not found")
+        return json_response(200, evaluation_instance_to_dict(i))
+
+    @app.route("PUT", r"/v1/evaluation_instances/(?P<id>[^/]+)")
+    def vi_update(req: Request) -> Response:
+        i = evaluation_instance_from_dict(req.json())
+        return json_response(200, {"ok": rt.evaluation_instances().update(i)})
+
+    @app.route("DELETE", r"/v1/evaluation_instances/(?P<id>[^/]+)")
+    def vi_delete(req: Request) -> Response:
+        return json_response(
+            200, {"ok": rt.evaluation_instances().delete(req.params["id"])}
+        )
+
+    # -- models (blob store; multipart maps onto keyed blobs client-side) ----
+    @app.route("PUT", r"/v1/models/(?P<id>.+)")
+    def models_put(req: Request) -> Response:
+        rt.models().insert(req.params["id"], req.body)
+        return json_response(200, {"ok": True})
+
+    @app.route("GET", r"/v1/models/(?P<id>.+)")
+    def models_get(req: Request) -> Response:
+        blob = rt.models().get(req.params["id"])
+        if blob is None:
+            return error_response(404, "model not found")
+        return Response(200, blob, content_type="application/octet-stream")
+
+    @app.route("DELETE", r"/v1/models/(?P<id>.+)")
+    def models_delete(req: Request) -> Response:
+        return json_response(200, {"ok": rt.models().delete(req.params["id"])})
+
+    # -- LEvents -------------------------------------------------------------
+    @app.route("POST", r"/v1/apps/(?P<app>\d+)/init")
+    def ev_init(req: Request) -> Response:
+        ok = rt.l_events().init(int(req.params["app"]), _chan(req))
+        return json_response(200, {"ok": ok})
+
+    @app.route("POST", r"/v1/apps/(?P<app>\d+)/remove")
+    def ev_remove(req: Request) -> Response:
+        ok = rt.l_events().remove(int(req.params["app"]), _chan(req))
+        return json_response(200, {"ok": ok})
+
+    @app.route("POST", r"/v1/apps/(?P<app>\d+)/events")
+    def ev_insert(req: Request) -> Response:
+        try:
+            events = [Event.from_api_dict(d) for d in req.json()]
+        except (EventValidationError, TypeError, KeyError) as e:
+            return error_response(400, f"invalid event: {e}")
+        ids = rt.l_events().insert_batch(
+            events, int(req.params["app"]), _chan(req)
+        )
+        return json_response(200, {"ids": ids})
+
+    @app.route("GET", r"/v1/apps/(?P<app>\d+)/events")
+    def ev_find(req: Request) -> Response:
+        events = rt.l_events().find(
+            int(req.params["app"]), _chan(req), _req_filter(req)
+        )
+        return json_response(200, [e.to_api_dict() for e in events])
+
+    @app.route("GET", r"/v1/apps/(?P<app>\d+)/events/(?P<eid>[^/]+)")
+    def ev_get(req: Request) -> Response:
+        e = rt.l_events().get(req.params["eid"], int(req.params["app"]), _chan(req))
+        if e is None:
+            return error_response(404, "event not found")
+        return json_response(200, e.to_api_dict())
+
+    @app.route("DELETE", r"/v1/apps/(?P<app>\d+)/events/(?P<eid>[^/]+)")
+    def ev_delete(req: Request) -> Response:
+        ok = rt.l_events().delete(
+            req.params["eid"], int(req.params["app"]), _chan(req)
+        )
+        return json_response(200, {"ok": ok})
+
+    # -- PEvents (bulk columnar, shard-addressable) --------------------------
+    @app.route("GET", r"/v1/apps/(?P<app>\d+)/shards")
+    def fr_shards(req: Request) -> Response:
+        """The shard count the scan protocol is keyed on — the APP's actual
+        layout via the PEvents.n_shards contract (a parquet app dir records
+        its n_shards at creation, which may differ from the daemon's
+        default)."""
+        n = rt.p_events().n_shards(int(req.params["app"]), _chan(req))
+        return json_response(200, {"n_shards": n})
+
+    @app.route("GET", r"/v1/apps/(?P<app>\d+)/frame")
+    def fr_scan(req: Request) -> Response:
+        """Bulk scan; ``shards`` (CSV of shard indices) restricts to those
+        entity-hash shards in ONE request/scan — SQL-backed stores split a
+        single table scan on the host, so a grouped fetch costs one scan
+        instead of one per shard."""
+        app_id, chan, flt = int(req.params["app"]), _chan(req), _req_filter(req)
+        pe = rt.p_events()
+        csv = req.query.get("shards")
+        if csv is not None and hasattr(pe, "iter_shards"):
+            want = [int(x) for x in csv.split(",") if x != ""]
+            frames = [
+                f for _, f in pe.iter_shards(app_id, chan, flt, shards=want)
+            ]
+            frame = _concat_frames(frames)
+        else:
+            frame = pe.find(app_id, chan, flt)
+        return Response(
+            200, encode_frame(frame), content_type="application/x-pio-frame"
+        )
+
+    @app.route("POST", r"/v1/apps/(?P<app>\d+)/frame")
+    def fr_write(req: Request) -> Response:
+        frame = decode_frame(req.body)
+        rt.p_events().write(frame, int(req.params["app"]), _chan(req))
+        return json_response(200, {"ok": True, "rows": len(frame)})
+
+    @app.route("POST", r"/v1/apps/(?P<app>\d+)/frame_delete")
+    def fr_delete(req: Request) -> Response:
+        ids = req.json().get("ids", [])
+        rt.p_events().delete(ids, int(req.params["app"]), _chan(req))
+        return json_response(200, {"ok": True})
+
+    return app
+
+
+def runtime_for_root(root: str | Path, events: str = "parquet") -> StorageRuntime:
+    """Self-contained storage topology under one root directory: sqlite
+    metadata + models, parquet (default) or sqlite events."""
+    root = Path(root)
+    env = {"PIO_HOME": str(root)}
+    if events == "parquet":
+        env |= {
+            "PIO_STORAGE_SOURCES_PQ_TYPE": "parquet",
+            "PIO_STORAGE_SOURCES_PQ_PATH": str(root / "events_parquet"),
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "PQ",
+        }
+    return StorageRuntime(StorageConfig.from_env(env))
+
+
+class StorageServer:
+    """Bind-and-serve wrapper (the daemon entry)."""
+
+    def __init__(
+        self,
+        root: str | Path,
+        host: str = "0.0.0.0",
+        port: int = 7072,
+        access_key: str | None = None,
+        events: str = "parquet",
+    ):
+        self.runtime = runtime_for_root(root, events=events)
+        self.app = create_storage_app(self.runtime, access_key=access_key)
+        self.server = AppServer(self.app, host=host, port=port)
+        self.host, self.port = self.server.host, self.server.port
+
+    def start_background(self) -> "StorageServer":
+        self.server.start_background()
+        return self
+
+    def serve_forever(self) -> None:
+        self.server.serve_forever()
+
+    def shutdown(self) -> None:
+        self.server.shutdown()
+        self.runtime.close()

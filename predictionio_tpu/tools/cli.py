@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from pathlib import Path
 from typing import Any
@@ -331,6 +332,28 @@ def do_dashboard(args) -> int:
     return 0
 
 
+def do_storageserver(args) -> int:
+    """`pio storageserver`: run the remote storage daemon — the networked
+    storage fleet role the reference fills with Elasticsearch/HBase servers
+    (ESLEvents.scala:41); clients point PIO_STORAGE_SOURCES_*_TYPE=remote
+    at it."""
+    from predictionio_tpu.server.storage_server import StorageServer
+
+    server = StorageServer(
+        root=args.root,
+        host=args.ip,
+        port=args.port,
+        access_key=args.access_key,
+        events=args.events,
+    )
+    print(f"Storage daemon on http://{args.ip}:{server.port} (root={args.root})")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
 def do_run(args) -> int:
     """`pio run`: execute a user script with the framework importable
     (Console.scala:333's arbitrary-main-class analog)."""
@@ -633,6 +656,17 @@ def build_parser() -> argparse.ArgumentParser:
     db.add_argument("--port", type=int, default=9000)
     db.add_argument("--access-key", default=None)
     db.set_defaults(fn=do_dashboard)
+
+    ss = sub.add_parser("storageserver")
+    ss.add_argument("--ip", default="0.0.0.0")
+    ss.add_argument("--port", type=int, default=7072)
+    ss.add_argument(
+        "--root",
+        default=os.environ.get("PIO_HOME", str(Path.home() / ".predictionio_tpu")),
+    )
+    ss.add_argument("--access-key", default=None)
+    ss.add_argument("--events", choices=("parquet", "sqlite"), default="parquet")
+    ss.set_defaults(fn=do_storageserver)
 
     dm = sub.add_parser("daemon")
     dm.add_argument("pidfile")

@@ -115,7 +115,7 @@ def pg_server(tmp_path_factory):
 _pg_db_counter = [0]
 
 
-@pytest.fixture(params=["sqlite", "parquet", "postgres"])
+@pytest.fixture(params=["sqlite", "parquet", "postgres", "remote"])
 def storage(request, tmp_path, pg_server):
     from predictionio_tpu.data.storage.config import (
         StorageConfig,
@@ -123,6 +123,24 @@ def storage(request, tmp_path, pg_server):
     )
 
     env = {"PIO_HOME": str(tmp_path / "pio_home")}
+    daemon = None
+    if request.param == "remote":
+        # in-process storage daemon (the ES server-fleet role) on an
+        # ephemeral port; all three repositories go through it
+        from predictionio_tpu.server.storage_server import StorageServer
+
+        daemon = StorageServer(
+            tmp_path / "daemon_root", host="127.0.0.1", port=0
+        ).start_background()
+        env |= {
+            "PIO_STORAGE_SOURCES_REMOTE_TYPE": "remote",
+            "PIO_STORAGE_SOURCES_REMOTE_URL": (
+                f"http://127.0.0.1:{daemon.port}"
+            ),
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "REMOTE",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "REMOTE",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "REMOTE",
+        }
     if request.param == "parquet":
         env |= {
             "PIO_STORAGE_SOURCES_PQ_TYPE": "parquet",
@@ -158,6 +176,8 @@ def storage(request, tmp_path, pg_server):
     rt = reset_storage(StorageConfig.from_env(env))
     yield rt
     rt.close()
+    if daemon is not None:
+        daemon.shutdown()
 
 
 def t(i):
