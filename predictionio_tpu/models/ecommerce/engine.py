@@ -135,9 +135,9 @@ class ECommDataSource(DataSource):
             event_names=list(self.params.event_names),
         )
         ratings = np.ones(len(frame), np.float32)
-        for i, props in enumerate(frame.properties):
-            if isinstance(props, dict) and "rating" in props:
-                ratings[i] = float(props["rating"])
+        r = frame.property_column("rating")
+        has_r = ~np.isnan(r)
+        ratings[has_r] = r[has_r]
         return TrainingData(
             users=users,
             items=items,

@@ -48,7 +48,10 @@ class NCFAlgorithmParams:
     num_epochs: int = 5
     batch_size: int = 8192
     positive_threshold: float = 4.0  # ratings >= this are positives
+    negatives_per_positive: int = 1  # K sampled negatives per step
     neg_power: float = 0.0  # see ops.ncf.NCFParams.neg_power
+    loss: str = "bpr"  # "bpr" | "softmax" (sampled softmax over 1+K)
+    item_bias: bool = True  # learned per-item score offset
     seed: int = 3
 
     params_aliases = {
@@ -58,7 +61,9 @@ class NCFAlgorithmParams:
         "numEpochs": "num_epochs",
         "batchSize": "batch_size",
         "positiveThreshold": "positive_threshold",
+        "negativesPerPositive": "negatives_per_positive",
         "negPower": "neg_power",
+        "itemBias": "item_bias",
     }
 
 
@@ -153,7 +158,10 @@ class NCFAlgorithm(Algorithm):
                 learning_rate=p.learning_rate,
                 num_epochs=p.num_epochs,
                 batch_size=p.batch_size,
+                negatives_per_positive=p.negatives_per_positive,
                 neg_power=p.neg_power,
+                loss=p.loss,
+                item_bias=p.item_bias,
                 seed=p.seed,
             ),
             mesh=mesh,

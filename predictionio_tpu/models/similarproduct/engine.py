@@ -150,10 +150,11 @@ class SimilarProductDataSource(DataSource):
             event_names=list(self.params.event_names),
         )
         weights = np.where(frame.event == "dislike", -1.0, 1.0).astype(np.float32)
-        # rate events carry their rating as the weight (train-with-rate-event)
-        for i, props in enumerate(frame.properties):
-            if isinstance(props, dict) and "rating" in props:
-                weights[i] = float(props["rating"])
+        # rate events carry their rating as the weight (train-with-rate-event);
+        # property_column is columnar over lazy rows — no per-event loop
+        r = frame.property_column("rating")
+        has_r = ~np.isnan(r)
+        weights[has_r] = r[has_r]
         return TrainingData(
             users=users,
             items=items,

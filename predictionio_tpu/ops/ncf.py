@@ -38,12 +38,22 @@ class NCFParams:
     learning_rate: float = 1e-3
     num_epochs: int = 5
     batch_size: int = 8192
-    negatives_per_positive: int = 4
+    #: negatives per positive per step.  BPR consumes them as independent
+    #: pairwise terms; softmax ranks the positive against all of them
+    #: jointly in one (1+K)-way classification.
+    negatives_per_positive: int = 1
     #: negative-sampling distribution exponent over item train frequency:
     #: 0.0 = uniform over the catalog; 0.75 = popularity-smoothed (the
     #: word2vec/BPR standard) — harder negatives, much better top-k ranking
     #: on Zipf-shaped catalogs
     neg_power: float = 0.0
+    #: ranking loss: "bpr" (pairwise log-sigmoid) or "softmax" (sampled
+    #: softmax cross-entropy over 1+K candidates — usually stronger top-k)
+    loss: str = "bpr"
+    #: learned per-item score offset.  Catalogs with popularity-driven
+    #: feedback are mostly explained by a bias term; giving the model one
+    #: explicitly frees the embeddings for the interaction structure.
+    item_bias: bool = True
     seed: int = 3
 
 
@@ -67,6 +77,8 @@ def init_ncf(rng: jax.Array, n_users: int, n_items: int, p: NCFParams) -> dict:
         "out_w": jax.random.normal(keys[2], (d + p.mlp_layers[-1], 1)) * 0.1,
         "out_b": jnp.zeros((1,)),
     }
+    if p.item_bias:
+        params["item_bias"] = jnp.zeros((n_items,))
     in_dim = 2 * d
     for li, width in enumerate(p.mlp_layers):
         params["mlp"].append(
@@ -90,7 +102,11 @@ def ncf_forward(params: dict, user_idx: jax.Array, item_idx: jax.Array) -> jax.A
     for layer in params["mlp"]:
         h = jax.nn.relu(h @ layer["w"] + layer["b"])
     fused = jnp.concatenate([gmf, h], axis=-1)
-    return (fused @ params["out_w"] + params["out_b"])[..., 0]
+    score = (fused @ params["out_w"] + params["out_b"])[..., 0]
+    bias = params.get("item_bias")  # absent on pre-bias checkpoints
+    if bias is not None:
+        score = score + bias[item_idx]
+    return score
 
 
 def score_all_items(params: dict, user_idx: jax.Array) -> jax.Array:
@@ -110,14 +126,36 @@ def score_all_items(params: dict, user_idx: jax.Array) -> jax.Array:
     for layer in params["mlp"]:
         h = jax.nn.relu(h @ layer["w"] + layer["b"])
     fused = jnp.concatenate([gmf, h], axis=-1)
-    return (fused @ params["out_w"] + params["out_b"])[..., 0]
+    score = (fused @ params["out_w"] + params["out_b"])[..., 0]
+    bias = params.get("item_bias")
+    if bias is not None:
+        score = score + bias
+    return score
 
 
 def bpr_loss(params: dict, user_idx, pos_idx, neg_idx, valid) -> jax.Array:
-    """Bayesian Personalized Ranking: -log sigmoid(s_pos - s_neg)."""
-    pos = ncf_forward(params, user_idx, pos_idx)
-    neg = ncf_forward(params, user_idx, neg_idx)
-    losses = -jax.nn.log_sigmoid(pos - neg) * valid
+    """Bayesian Personalized Ranking over K negatives: mean over pairs of
+    -log sigmoid(s_pos - s_neg).  ``neg_idx`` is [b, K]."""
+    b, k = neg_idx.shape
+    pos = ncf_forward(params, user_idx, pos_idx)  # [b]
+    neg = ncf_forward(
+        params, jnp.repeat(user_idx, k), neg_idx.reshape(-1)
+    ).reshape(b, k)
+    losses = -jax.nn.log_sigmoid(pos[:, None] - neg).mean(axis=1) * valid
+    return losses.sum() / jnp.maximum(valid.sum(), 1.0)
+
+
+def sampled_softmax_loss(params: dict, user_idx, pos_idx, neg_idx, valid):
+    """(1+K)-way sampled softmax: the positive must out-rank all K sampled
+    negatives jointly — a tighter proxy for top-k ranking than independent
+    pairwise terms.  ``neg_idx`` is [b, K]."""
+    b, k = neg_idx.shape
+    pos = ncf_forward(params, user_idx, pos_idx)  # [b]
+    neg = ncf_forward(
+        params, jnp.repeat(user_idx, k), neg_idx.reshape(-1)
+    ).reshape(b, k)
+    logits = jnp.concatenate([pos[:, None], neg], axis=1)  # [b, 1+K]
+    losses = -jax.nn.log_softmax(logits, axis=1)[:, 0] * valid
     return losses.sum() / jnp.maximum(valid.sum(), 1.0)
 
 
@@ -155,21 +193,37 @@ _EPOCH_CACHE_MAX = 8
 
 
 def _get_epoch_fn(
-    n_steps: int, batch_size: int, n_items: int, lr: float, mesh_key
+    n_steps: int,
+    batch_size: int,
+    n_items: int,
+    lr: float,
+    mesh_key,
+    loss: str = "bpr",
+    k_neg: int = 1,
 ):
-    key = (n_steps, batch_size, n_items, lr, mesh_key)
+    key = (n_steps, batch_size, n_items, lr, mesh_key, loss, k_neg)
     hit = _EPOCH_CACHE.get(key)
     if hit is not None:
         return hit
     while len(_EPOCH_CACHE) >= _EPOCH_CACHE_MAX:
         del _EPOCH_CACHE[next(iter(_EPOCH_CACHE))]
     optimizer = optax.adam(lr)
-    pair = (optimizer, make_epoch_fn(optimizer, n_steps, batch_size, n_items))
+    pair = (
+        optimizer,
+        make_epoch_fn(optimizer, n_steps, batch_size, n_items, loss, k_neg),
+    )
     _EPOCH_CACHE[key] = pair
     return pair
 
 
-def make_epoch_fn(optimizer, n_steps: int, batch_size: int, n_items: int):
+def make_epoch_fn(
+    optimizer,
+    n_steps: int,
+    batch_size: int,
+    n_items: int,
+    loss: str = "bpr",
+    k_neg: int = 1,
+):
     """One compiled program per EPOCH: device-side shuffle, in-step negative
     sampling, and a lax.scan over all batches.
 
@@ -181,6 +235,8 @@ def make_epoch_fn(optimizer, n_steps: int, batch_size: int, n_items: int):
     GSPMD-inserted all-reduce + Adam).
     """
 
+    loss_fn = sampled_softmax_loss if loss == "softmax" else bpr_loss
+
     # donate params+opt_state: the caller always rebinds them, so XLA can
     # update the tables and Adam moments in place instead of copying
     # ~3x the parameter bytes every epoch
@@ -191,27 +247,31 @@ def make_epoch_fn(optimizer, n_steps: int, batch_size: int, n_items: int):
         us = u_all[perm].reshape(n_steps, batch_size)
         ps = i_all[perm].reshape(n_steps, batch_size)
         vs = valid_all[perm].reshape(n_steps, batch_size)
-        # one sampled negative per positive per step; extra negatives come
-        # from running more epochs (same expected update count).  Sampling
-        # is inverse-CDF over ``neg_cdf`` (uniform or popularity-smoothed
-        # per NCFParams.neg_power) — a [b]-wide searchsorted, on device.
-        negs = jnp.searchsorted(
-            neg_cdf,
-            jax.random.uniform(kneg, (n_steps, batch_size)),
-        ).astype(jnp.int32)
-        negs = jnp.minimum(negs, n_items - 1)
+        # K sampled negatives per positive, drawn PER STEP inside the scan
+        # body (a whole-epoch [n_steps, b, K] tensor would pad its minor
+        # K dim to 128 lanes — 16x memory blowup at K=8, OOM at ML-20M
+        # scale).  Inverse-CDF over ``neg_cdf`` (uniform or
+        # popularity-smoothed per NCFParams.neg_power).
+        step_keys = jax.random.split(kneg, n_steps)
 
         def body(carry, xs):
             params, opt_state = carry
-            u, pos, neg, valid = xs
-            loss, grads = jax.value_and_grad(bpr_loss)(
+            u, pos, valid, kstep = xs
+            neg = jnp.searchsorted(
+                neg_cdf, jax.random.uniform(kstep, (batch_size, k_neg))
+            ).astype(jnp.int32)
+            neg = jnp.minimum(neg, n_items - 1)
+            step_loss, grads = jax.value_and_grad(loss_fn)(
                 params, u, pos, neg, valid
             )
             updates, opt_state = optimizer.update(grads, opt_state, params)
-            return (optax.apply_updates(params, updates), opt_state), loss
+            return (
+                (optax.apply_updates(params, updates), opt_state),
+                step_loss,
+            )
 
         (params, opt_state), losses = jax.lax.scan(
-            body, (params, opt_state), (us, ps, negs, vs)
+            body, (params, opt_state), (us, ps, vs, step_keys)
         )
         return params, opt_state, losses.mean()
 
@@ -273,7 +333,13 @@ def train_ncf(
     bs = ((bs + data_par - 1) // data_par) * data_par
     n_steps = max((n_pos + bs - 1) // bs, 1)
     optimizer, epoch_fn = _get_epoch_fn(
-        n_steps, bs, n_items, p.learning_rate, mesh
+        n_steps,
+        bs,
+        n_items,
+        p.learning_rate,
+        mesh,
+        loss=p.loss,
+        k_neg=max(p.negatives_per_positive, 1),
     )
     opt_state = optimizer.init(net)
 

@@ -377,6 +377,15 @@ def create_storage_app(
         rt.p_events().write(frame, int(req.params["app"]), _chan(req))
         return json_response(200, {"ok": True, "rows": len(frame)})
 
+    @app.route("POST", r"/v1/apps/(?P<app>\d+)/compact")
+    def fr_compact(req: Request) -> Response:
+        pe = rt.p_events()
+        fn = getattr(pe, "compact", None)
+        if fn is None:  # SQL stores rewrite in place; nothing to fold
+            return json_response(200, {"supported": False, "rows": 0})
+        rows = fn(int(req.params["app"]), _chan(req))
+        return json_response(200, {"supported": True, "rows": rows})
+
     @app.route("POST", r"/v1/apps/(?P<app>\d+)/frame_delete")
     def fr_delete(req: Request) -> Response:
         ids = req.json().get("ids", [])

@@ -103,6 +103,12 @@ def do_app(args) -> int:
     elif args.app_command == "data-delete":
         cmd.app_data_delete(storage, args.name, channel=args.channel)
         print(f"Data of app {args.name} deleted.")
+    elif args.app_command == "compact":
+        rows = cmd.app_compact(storage, args.name, channel=args.channel)
+        if rows is None:
+            print("Event store rewrites in place; nothing to compact.")
+        else:
+            print(f"Compacted app {args.name}: {rows} live events.")
     elif args.app_command == "channel-new":
         ch = cmd.channel_new(storage, args.name, args.channel)
         _print({"id": ch.id, "name": ch.name, "appid": ch.appid})
@@ -555,6 +561,10 @@ def build_parser() -> argparse.ArgumentParser:
     show.add_argument("name")
     dele = asub.add_parser("delete")
     dele.add_argument("name")
+    ac = asub.add_parser("compact")
+    ac.add_argument("name")
+    ac.add_argument("--channel", default=None)
+
     dd = asub.add_parser("data-delete")
     dd.add_argument("name")
     dd.add_argument("--channel")

@@ -143,6 +143,23 @@ def app_data_delete(
     levents.init(app.id)
 
 
+def app_compact(
+    storage: StorageRuntime, name: str, channel: str | None = None
+) -> int | None:
+    """Fold the app's event-log segments (parquet/remote stores only; the
+    HBase major-compaction role).  Returns live rows, or None when the
+    configured event store rewrites in place and has nothing to fold."""
+    app = _require_app(storage, name)
+    channel_id = (
+        _require_channel(storage, app, channel).id if channel else None
+    )
+    pe = storage.p_events()
+    fn = getattr(pe, "compact", None)
+    if fn is None:
+        return None
+    return fn(app.id, channel_id)
+
+
 # -- channels ---------------------------------------------------------------
 
 

@@ -46,6 +46,15 @@ class TestAppVerbs:
         cli_main(["app", "new", "a2"])
         assert cli_main(["app", "channel-new", "a2", "bad name!"]) == 1
 
+    def test_app_compact(self, capsys, global_storage):
+        """`pio app compact` on the default (sqlite) store reports the
+        rewrite-in-place no-op path; the parquet/remote fold path is
+        covered in test_remote_storage."""
+        cli_main(["app", "new", "a3"])
+        capsys.readouterr()
+        assert cli_main(["app", "compact", "a3"]) == 0
+        assert "nothing to compact" in capsys.readouterr().out
+
 
 class TestAccessKeyVerbs:
     def test_accesskey_lifecycle(self, capsys):

@@ -98,7 +98,7 @@ for _, frame in pe.iter_shards(1, shards=my_shards):
     sel = frame.where_event("rate")
     us.append(np.array([int(s[1:]) for s in sel.entity_id], np.int32))
     is_.append(np.array([int(s[1:]) for s in sel.target_entity_id], np.int32))
-    rs.append(np.array([p.get("rating", 0.0) for p in sel.properties], np.float32))
+    rs.append(sel.property_column("rating", default=0.0))
 u = np.concatenate(us); i = np.concatenate(is_); r = np.concatenate(rs)
 print(f"proc {rank}: {len(u)} rows from shards {my_shards}", file=sys.stderr)
 
@@ -280,7 +280,7 @@ for _, frame in pe.iter_shards(1, shards=my_shards):
     sel = frame.where_event("rate")
     us.append(np.array([int(s[1:]) for s in sel.entity_id], np.int32))
     is_.append(np.array([int(s[1:]) for s in sel.target_entity_id], np.int32))
-    rs.append(np.array([p.get("rating", 0.0) for p in sel.properties], np.float32))
+    rs.append(sel.property_column("rating", default=0.0))
 u = np.concatenate(us); i = np.concatenate(is_); r = np.concatenate(rs)
 print(f"proc {rank}: {len(u)} rows from sql shards {my_shards}", file=sys.stderr)
 

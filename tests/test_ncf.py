@@ -65,6 +65,62 @@ class TestNCFOps:
         scores1 = np.asarray(score_all_items(state.params, jnp.int32(1)))
         assert scores1[15:30].mean() > scores1[:15].mean()
 
+    def test_softmax_loss_learns_clusters(self):
+        """Sampled-softmax over K negatives must learn the same structure
+        as BPR (it is the stronger top-k proxy the bench uses)."""
+        rng = np.random.default_rng(0)
+        users, items = _cluster_interactions(rng)
+        state = train_ncf(
+            users,
+            items,
+            n_users=40,
+            n_items=30,
+            params=NCFParams(
+                embed_dim=8, mlp_layers=(16, 8), num_epochs=150,
+                batch_size=256, learning_rate=5e-3,
+                loss="softmax", negatives_per_positive=4,
+            ),
+        )
+        scores = np.asarray(score_all_items(state.params, jnp.int32(0)))
+        assert scores[:15].mean() > scores[15:30].mean()
+
+    def test_item_bias_toggle_and_checkpoint_compat(self):
+        """item_bias=True adds a trained per-item offset; a params dict
+        WITHOUT the leaf (pre-bias checkpoint) still scores."""
+        rng = np.random.default_rng(0)
+        users, items = _cluster_interactions(rng)
+        cfg = dict(
+            embed_dim=8, mlp_layers=(16, 8), num_epochs=20,
+            batch_size=256, learning_rate=5e-3,
+        )
+        with_bias = train_ncf(
+            users, items, n_users=40, n_items=30,
+            params=NCFParams(item_bias=True, **cfg),
+        )
+        assert "item_bias" in with_bias.params
+        assert np.abs(np.asarray(with_bias.params["item_bias"])).max() > 0
+        without = train_ncf(
+            users, items, n_users=40, n_items=30,
+            params=NCFParams(item_bias=False, **cfg),
+        )
+        assert "item_bias" not in without.params
+        s = np.asarray(score_all_items(without.params, jnp.int32(0)))
+        assert s.shape == (30,) and np.isfinite(s).all()
+
+    def test_multi_negatives_bpr(self):
+        rng = np.random.default_rng(0)
+        users, items = _cluster_interactions(rng)
+        state = train_ncf(
+            users, items, n_users=40, n_items=30,
+            params=NCFParams(
+                embed_dim=8, mlp_layers=(16, 8), num_epochs=100,
+                batch_size=256, learning_rate=5e-3,
+                negatives_per_positive=4,
+            ),
+        )
+        scores = np.asarray(score_all_items(state.params, jnp.int32(0)))
+        assert scores[:15].mean() > scores[15:30].mean()
+
     def test_sharded_training_matches_semantics(self):
         """Train on a 2x2 (data x model) mesh: tables row-sharded, batch
         data-parallel; loss must decrease and factors stay finite."""

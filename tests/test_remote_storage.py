@@ -72,8 +72,14 @@ class TestFrameCodec:
         assert out.entity_id.tolist() == frame.entity_id.tolist()
         # None target round-trips as None, not ""
         assert out.target_entity_type[2] is None
-        assert out.properties[0] == {"rating": 4.5}
-        assert out.properties[2] == {}
+        # properties decode LAZILY (raw JSON strings; "" = empty document)
+        assert json.loads(out.properties[0]) == {"rating": 4.5}
+        assert out.properties[2] == ""
+        # semantic accessors resolve lazy rows transparently
+        np.testing.assert_allclose(
+            out.property_column("rating")[:1], [4.5]
+        )
+        assert out.to_events()[0].properties.fields == {"rating": 4.5}
         assert out.event_id.tolist() == frame.event_id.tolist()
         np.testing.assert_array_equal(out.event_time_ms, frame.event_time_ms)
         np.testing.assert_array_equal(
@@ -96,7 +102,7 @@ class TestFrameCodec:
         )
         out = decode_frame(encode_frame(frame))
         assert out.event_id is None and out.tags is None
-        assert out.properties[1] == {"x": 1}
+        assert json.loads(out.properties[1]) == {"x": 1}
 
     def test_rejects_junk(self):
         with pytest.raises(ValueError):
@@ -163,6 +169,18 @@ class TestRemoteScan:
         pe.delete([frame.event_id[0]], 1)
         left = pe.find(1)
         assert left.entity_id.tolist() == ["u2"]
+
+    def test_remote_compact(self, daemon, client):
+        """Daemon-side segment compaction: tombstoned rows fold away and
+        the live count comes back over the wire."""
+        pe = RemotePEvents(client)
+        frame = EventFrame.from_events(
+            [mk("view", f"u{i}", i % 50).with_id() for i in range(10)]
+        )
+        pe.write(frame, 1)
+        pe.delete(list(frame.event_id[:4]), 1)
+        assert pe.compact(1) == 6
+        assert len(pe.find(1)) == 6
 
 
 class TestAuthAndOps:

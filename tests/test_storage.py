@@ -304,6 +304,68 @@ class TestPEvents:
                                             t(3).timestamp() * 1000]
 
 
+class TestLazyProperties:
+    """EventFrame lazy-row contract: properties may be raw JSON strings;
+    semantic accessors must match the eager-dict behavior exactly."""
+
+    def _frame(self, props):
+        import numpy as np
+
+        from predictionio_tpu.data.storage.base import EventFrame
+
+        n = len(props)
+        return EventFrame(
+            event=np.full(n, "e", object),
+            entity_type=np.full(n, "user", object),
+            entity_id=np.array([f"u{i}" for i in range(n)], object),
+            target_entity_type=np.full(n, None, object),
+            target_entity_id=np.full(n, None, object),
+            event_time_ms=np.arange(n, dtype=np.int64),
+            properties=np.array(props, object),
+        )
+
+    def test_property_column_lazy_matches_eager(self):
+        import numpy as np
+
+        lazy = self._frame(
+            ['{"rating": 4.5}', "", '{"rating": 2}', '{"other": 1}',
+             '{"nested": {"rating": 9}}']
+        )
+        eager = self._frame(
+            [{"rating": 4.5}, {}, {"rating": 2}, {"other": 1},
+             {"nested": {"rating": 9}}]
+        )
+        np.testing.assert_array_equal(
+            lazy.property_column("rating"), eager.property_column("rating")
+        )
+        got = lazy.property_column("rating")
+        np.testing.assert_allclose(got[[0, 2]], [4.5, 2.0])
+        assert np.isnan(got[[1, 3, 4]]).all()  # nested key does NOT count
+
+    def test_property_column_non_numeric_and_bool_excluded(self):
+        import numpy as np
+
+        lazy = self._frame(['{"v": "high"}', '{"v": true}', '{"v": 3}'])
+        eager = self._frame([{"v": "high"}, {"v": True}, {"v": 3}])
+        np.testing.assert_array_equal(
+            lazy.property_column("v"), eager.property_column("v")
+        )
+
+    def test_to_events_decodes_lazy_rows(self):
+        lazy = self._frame(['{"rating": 4.5}', ""])
+        evs = lazy.to_events()
+        assert evs[0].properties.fields == {"rating": 4.5}
+        assert evs[1].properties.fields == {}
+
+    def test_mixed_lazy_and_dict_rows(self):
+        import numpy as np
+
+        mixed = self._frame([{"rating": 1.0}, '{"rating": 2.0}', ""])
+        np.testing.assert_allclose(
+            mixed.property_column("rating")[:2], [1.0, 2.0]
+        )
+
+
 class TestParquetRegressions:
     """Round-2 parquet bugs: null event ids, dedup-vs-filter order, channel 0."""
 
