@@ -682,7 +682,7 @@ class RemotePEvents(base.PEvents):
         is re-split locally by the same entity-hash function the layouts
         use.  Callers needing memory-bounded streaming can pass singleton
         ``shards`` lists per call."""
-        from predictionio_tpu.data.storage.base import entity_shard
+        from predictionio_tpu.data.storage.base import frame_shard_of
 
         if shards is not None and len(shards) == 1:
             # singleton fast path: no /shards round trip, no local re-split
@@ -698,28 +698,7 @@ class RemotePEvents(base.PEvents):
             _filter_params(channel_id, filter)
             | {"shards": ",".join(str(k) for k in want)},
         )
-        # re-split by hashing each UNIQUE entity once (entities are ~100x
-        # fewer than events; a per-row Python md5 loop would dwarf the
-        # transfer cost at 20M rows) — same factorize trick as the parquet
-        # writer's shard grouping
-        import pandas as pd
-
-        tcode, utypes = pd.factorize(frame.entity_type)
-        icode, uids = pd.factorize(frame.entity_id)
-        inv, upairs = pd.factorize(
-            tcode.astype(np.int64) * len(uids) + icode
-        )
-        utypes = np.asarray(utypes, object)
-        uids = np.asarray(uids, object)
-        shard_of_uniq = np.fromiter(
-            (
-                entity_shard(utypes[c // len(uids)], uids[c % len(uids)], n)
-                for c in upairs
-            ),
-            np.int64,
-            len(upairs),
-        )
-        shard_of = shard_of_uniq[inv]
+        shard_of = frame_shard_of(frame.entity_type, frame.entity_id, n)
         for k in want:
             yield k, frame.take(shard_of == k)
 

@@ -362,7 +362,7 @@ class SQLitePEvents(base.PEvents):
         Server dialects that can hash in SQL (Postgres) filter rows
         server-side, so each process only transfers its own shards.
         """
-        from predictionio_tpu.data.storage.base import entity_shard
+        from predictionio_tpu.data.storage.base import frame_shard_of
 
         n = n_shards or self.N_SCAN_SHARDS
         want = list(range(n)) if shards is None else list(shards)
@@ -373,14 +373,7 @@ class SQLitePEvents(base.PEvents):
         # backend returns identical rows for identical filters
         if expr is None or f.limit is not None:
             frame = self.find(app_id, channel_id, filter)
-            shard_of = np.fromiter(
-                (
-                    entity_shard(t, e, n)
-                    for t, e in zip(frame.entity_type, frame.entity_id)
-                ),
-                np.int64,
-                len(frame),
-            )
+            shard_of = frame_shard_of(frame.entity_type, frame.entity_id, n)
             for k in want:
                 yield k, frame.take(shard_of == k)
             return
