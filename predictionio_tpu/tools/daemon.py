@@ -126,15 +126,85 @@ STACK = (
 )
 
 
+def _local_storage_daemon_source() -> tuple[int, str | None] | None:
+    """(port, auth_key) of a loopback-addressed ``remote`` storage source,
+    if any of the three repositories resolves to one — the analog of
+    bin/pio-start-all's conditional Elasticsearch/HBase boot (the
+    reference starts the storage services a single-node config points
+    at)."""
+    from urllib.parse import urlsplit
+
+    from predictionio_tpu.data.storage.config import StorageConfig
+
+    cfg = StorageConfig.from_env()
+    for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+        try:
+            _, props = cfg.source_for(repo)
+        except Exception:
+            continue
+        if props.get("TYPE") != "remote":
+            continue
+        # config.py accepts URL or HOSTS for remote sources — honor both
+        parts = urlsplit(props.get("URL") or props.get("HOSTS", ""))
+        if parts.hostname in ("127.0.0.1", "localhost"):
+            return parts.port or 7072, props.get("AUTHKEY")
+    return None
+
+
+def _wait_for_storage_daemon(port: int, timeout_s: float = 90.0) -> bool:
+    """Block until the daemon answers /v1/ping (the reference's
+    pio-start-all sleeps for storage readiness before booting the rest of
+    the stack).  A 401 means the daemon is up with key auth on — that
+    counts as ready."""
+    import time
+    import urllib.error
+    import urllib.request
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/ping", timeout=2
+            ).read()
+            return True
+        except urllib.error.HTTPError:
+            return True  # listening; auth/4xx is still "up"
+        except Exception:
+            time.sleep(0.5)
+    return False
+
+
 def start_all(
     ip: str = "0.0.0.0",
     ports: dict[str, str] | None = None,
     extra_args: dict[str, list[str]] | None = None,
 ) -> dict[str, int]:
-    """Start the full stack; returns {name: pid}."""
+    """Start the full stack; returns {name: pid}.  When the storage
+    topology binds a repository to a loopback ``remote`` source, the
+    storage daemon boots FIRST and start_all waits for it to answer
+    before the dependent services spawn, so the event/admin/dashboard
+    servers never race their own storage."""
     ports = ports or {}
     extra_args = extra_args or {}
     pids = {}
+    daemon = _local_storage_daemon_source()
+    if daemon is not None:
+        daemon_port, auth_key = daemon
+        args = [
+            "storageserver",
+            "--ip", "127.0.0.1",
+            "--port", str(ports.get("storageserver", daemon_port)),
+            *(["--access-key", auth_key] if auth_key else []),
+            *extra_args.get("storageserver", []),
+        ]
+        pids["storageserver"] = spawn_daemon(
+            args, _pid_dir() / "storageserver.pid"
+        )
+        if not _wait_for_storage_daemon(int(ports.get("storageserver", daemon_port))):
+            raise RuntimeError(
+                "storage daemon did not answer /v1/ping in time; check "
+                f"{_log_dir() / 'storageserver.log'}"
+            )
     for name, default_port in STACK:
         pidfile = _pid_dir() / f"{name}.pid"
         args = [

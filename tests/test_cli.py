@@ -234,6 +234,68 @@ class TestDaemonVerbs:
         with pytest.raises(Exception):
             urllib.request.urlopen(f"http://127.0.0.1:{ev}/", timeout=2)
 
+    def test_start_all_boots_local_storage_daemon(self, tmp_path, monkeypatch):
+        """With a repository bound to a loopback `remote` source,
+        start-all boots the storage daemon first (the reference's
+        pio-start-all starts the configured storage services,
+        bin/pio-start-all Elasticsearch branch)."""
+        import socket
+        import urllib.request
+
+        monkeypatch.setenv("PIO_HOME", str(tmp_path))
+        ports = []
+        for _ in range(4):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            s.close()
+        ev, ad, db, sp = ports
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_R_TYPE", "remote")
+        monkeypatch.setenv(
+            "PIO_STORAGE_SOURCES_R_URL", f"http://127.0.0.1:{sp}"
+        )
+        monkeypatch.setenv("PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE", "R")
+        assert (
+            cli_main(
+                [
+                    "start-all",
+                    "--ip", "127.0.0.1",
+                    "--event-port", str(ev),
+                    "--admin-port", str(ad),
+                    "--dashboard-port", str(db),
+                ]
+            )
+            == 0
+        )
+        try:
+            pid_dir = tmp_path / "pids"
+            assert "storageserver.pid" in {
+                p.name for p in pid_dir.glob("*.pid")
+            }
+            # generous budget: single-core CI boxes under load take tens of
+            # seconds just to import the child's dependency stack.  Catch
+            # only connection-class errors so a WRONG service answering
+            # the port fails immediately with the real mismatch.
+            import time
+            import urllib.error
+
+            got = None
+            for _ in range(120):
+                try:
+                    got = json.loads(
+                        urllib.request.urlopen(
+                            f"http://127.0.0.1:{sp}/v1/ping", timeout=2
+                        ).read()
+                    )
+                    break
+                except (urllib.error.URLError, ConnectionError, TimeoutError):
+                    time.sleep(0.5)
+            else:
+                raise AssertionError("storage daemon never came up")
+            assert got["service"] == "storage"
+        finally:
+            assert cli_main(["stop-all"]) == 0
+
     def test_daemon_one_off(self, tmp_path, monkeypatch):
         import socket
 
