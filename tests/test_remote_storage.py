@@ -295,6 +295,52 @@ class TestAuthAndOps:
         assert args.fn.__name__ == "do_storageserver"
 
 
+class TestDaemonErrorPaths:
+    """The daemon must answer malformed input with clean HTTP errors —
+    never a hung connection or a corrupted store."""
+
+    def test_invalid_event_batch_400(self, daemon, client):
+        status, raw = client.request(
+            "POST",
+            "/v1/apps/1/events",
+            body=json.dumps([{"entityType": "user"}]).encode(),  # no event
+        )
+        assert status == 400
+        assert b"invalid event" in raw
+
+    def test_malformed_frame_body_500_and_store_intact(self, daemon, client):
+        pe = RemotePEvents(client)
+        pe.write(EventFrame.from_events([mk("view", "u1", 1).with_id()]), 1)
+        status, _ = client.request(
+            "POST",
+            "/v1/apps/1/frame",
+            body=b"definitely not a PIOF1 frame",
+            content_type="application/x-pio-frame",
+        )
+        assert status == 500
+        assert len(pe.find(1)) == 1  # prior data untouched
+
+    def test_unknown_route_404_wrong_method_405(self, daemon, client):
+        status, _ = client.request("GET", "/v1/nope")
+        assert status == 404
+        status, _ = client.request("DELETE", "/v1/ping")
+        assert status == 405
+
+    def test_bad_filter_json_is_500_not_hang(self, daemon, client):
+        status, _ = client.request(
+            "GET", "/v1/apps/1/events", params={"filter": "{broken"}
+        )
+        assert status == 500
+
+    def test_get_missing_entities_404(self, daemon, client):
+        assert client.json("GET", "/v1/apps/id/999", ok_404=True) is None
+        assert client.json(
+            "GET", "/v1/engine_instances/nope", ok_404=True
+        ) is None
+        status, _ = client.request("GET", "/v1/models/ghost")
+        assert status == 404
+
+
 class TestRemoteQuickstart:
     def test_train_deploy_query_over_daemon(self, tmp_path):
         """The full user journey with ALL repositories behind the daemon:
