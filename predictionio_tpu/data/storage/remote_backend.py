@@ -33,8 +33,6 @@ import json
 import ssl
 import threading
 import time
-
-import numpy as np
 from datetime import datetime
 from typing import Any, Iterator, Sequence
 from urllib.parse import quote, urlencode, urlsplit
