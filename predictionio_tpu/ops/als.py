@@ -57,6 +57,11 @@ class ALSParams:
     #: "hilo" (2-pass, ~2^-16 rel err — default), "highest" (6-pass exact),
     #: "bf16" (1-pass, ~2^-8)
     pallas_precision: str = "hilo"
+    #: single-device pallas dispatch: "auto" picks the single-grid fused
+    #: kernel (packed rows built in VMEM, no chunk-scan accumulator
+    #: traffic) when the packed stream fits comfortably in HBM, else the
+    #: chunked scan; "fused"/"chunked" force a path
+    pallas_mode: str = "auto"
 
 
 @dataclass
@@ -256,11 +261,13 @@ def _use_pallas(p: "ALSParams") -> bool:
         return False
 
 
-def _make_pallas_step(key_shapes, p: ALSParams, num_users_pad, num_items_pad):
+def _make_pallas_step(
+    key_shapes, p: ALSParams, num_users_pad, num_items_pad, fused: bool
+):
     """Jitted one-iteration fn over pre-planned (sorted+padded) streams."""
     key = ("pallas", key_shapes, num_users_pad, num_items_pad, p.rank, p.reg,
            p.implicit_prefs, p.alpha, p.scale_reg_with_count,
-           p.pallas_precision)
+           p.pallas_precision, fused)
     cached = _STEP_CACHE.get(key)
     if cached is not None:
         return cached
@@ -273,11 +280,18 @@ def _make_pallas_step(key_shapes, p: ALSParams, num_users_pad, num_items_pad):
 
     def half(plan_args, oth, rat, val, other_factors, tpc, n_blocks,
              num_seg_pad):
-        acc = als_pallas.segment_stats_pallas(
-            plan_args, oth, rat, val, other_factors,
-            p.implicit_prefs, p.alpha, tpc, n_blocks,
-            precision=p.pallas_precision,
-        )[:num_seg_pad]
+        if fused:
+            acc = als_pallas.segment_stats_fused(
+                plan_args, oth, rat, val, other_factors,
+                p.implicit_prefs, p.alpha, tpc, n_blocks,
+                precision=p.pallas_precision,
+            )[:num_seg_pad]
+        else:
+            acc = als_pallas.segment_stats_pallas(
+                plan_args, oth, rat, val, other_factors,
+                p.implicit_prefs, p.alpha, tpc, n_blocks,
+                precision=p.pallas_precision,
+            )[:num_seg_pad]
         A = acc[:, : k * k].reshape(-1, k, k)
         b = acc[:, k * k : k * k + k]
         counts = acc[:, k * k + k]
@@ -344,24 +358,46 @@ def _train_pallas(user_idx, item_idx, rating, num_users, num_items,
     num_users_pad = max((num_users + 127) // 128 * 128, 128)
     num_items_pad = max((num_items + 127) // 128 * 128, 128)
 
+    # mode select: the fused single-grid kernel needs the packed stream
+    # ([P, packed_width] f32) resident per half-step; fall back to the
+    # chunk-scan when that transient would crowd HBM
+    mode = p.pallas_mode
+    if mode == "auto":
+        est_rows = int(len(user_idx) * 1.06) + als_pallas.T  # ~pad factor
+        packed_bytes = est_rows * als_pallas.packed_width(p.rank) * 4
+        mode = "fused" if packed_bytes <= 4 << 30 else "chunked"
+
     def stage(seg, oth, num_seg_pad):
-        plan = als_pallas.chunk_plan(
-            als_pallas.build_plan(np.asarray(seg, np.int64), num_seg_pad)
+        base_plan = als_pallas.build_plan(
+            np.asarray(seg, np.int64), num_seg_pad
         )
-        rows = plan.n_chunks * plan.tiles_per_chunk * als_pallas.T
-        oth_p = np.asarray(oth, np.int32)[plan.dest_perm]
-        rat_p = np.asarray(rating, np.float32)[plan.dest_perm]
+        if mode == "fused":
+            plan = base_plan
+            rows = plan.padded_len
+            perm, pad_mask = plan.dest_perm, plan.pad_mask
+            plan_args = (
+                jnp.asarray(plan.block_map),
+                jnp.asarray(plan.first),
+                jnp.asarray(plan.seg3),
+            )
+            shape2 = (rows,)
+        else:
+            plan = als_pallas.chunk_plan(base_plan)
+            rows = plan.n_chunks * plan.tiles_per_chunk * als_pallas.T
+            perm, pad_mask = plan.dest_perm, plan.pad_mask
+            plan_args = (
+                jnp.asarray(plan.block_map),
+                jnp.asarray(plan.first),
+                jnp.asarray(plan.seg3),
+                jnp.asarray(plan.visited),
+            )
+            shape2 = (plan.n_chunks, plan.tiles_per_chunk * als_pallas.T)
+        oth_p = np.asarray(oth, np.int32)[perm]
+        rat_p = np.asarray(rating, np.float32)[perm]
         val_p = np.ones(rows, np.float32)
-        oth_p[plan.pad_mask] = 0
-        rat_p[plan.pad_mask] = 0.0
-        val_p[plan.pad_mask] = 0.0
-        shape2 = (plan.n_chunks, plan.tiles_per_chunk * als_pallas.T)
-        plan_args = (
-            jnp.asarray(plan.block_map),
-            jnp.asarray(plan.first),
-            jnp.asarray(plan.seg3),
-            jnp.asarray(plan.visited),
-        )
+        oth_p[pad_mask] = 0
+        rat_p[pad_mask] = 0.0
+        val_p[pad_mask] = 0.0
         return (plan, plan_args, jnp.asarray(oth_p.reshape(shape2)),
                 jnp.asarray(rat_p.reshape(shape2)),
                 jnp.asarray(val_p.reshape(shape2)))
@@ -370,6 +406,7 @@ def _train_pallas(user_idx, item_idx, rating, num_users, num_items,
         _data_fingerprint(user_idx, item_idx, rating),
         num_users_pad,
         num_items_pad,
+        mode,
     )
     staged = _STAGE_CACHE.get(cache_key)
     if staged is None:
@@ -384,23 +421,34 @@ def _train_pallas(user_idx, item_idx, rating, num_users, num_items,
     (up, u_plan, u_oth, u_rat, u_val), (ip, i_plan, i_oth, i_rat, i_val) = (
         staged
     )
+    fused = mode == "fused"
+    if fused:
+        tiles_u, tiles_i = up.n_tiles, ip.n_tiles
+        rows_u, rows_i = up.padded_len, ip.padded_len
+        chunks_u = chunks_i = 1
+    else:
+        tiles_u, tiles_i = up.tiles_per_chunk, ip.tiles_per_chunk
+        rows_u = up.n_chunks * up.tiles_per_chunk * als_pallas.T
+        rows_i = ip.n_chunks * ip.tiles_per_chunk * als_pallas.T
+        chunks_u, chunks_i = up.n_chunks, ip.n_chunks
     LAST_PLAN_INFO.update(
         rank=p.rank,
         width=als_pallas.row_width(p.rank),
-        rows_user=up.n_chunks * up.tiles_per_chunk * als_pallas.T,
-        rows_item=ip.n_chunks * ip.tiles_per_chunk * als_pallas.T,
+        rows_user=rows_u,
+        rows_item=rows_i,
         blocks_user=up.n_blocks,
         blocks_item=ip.n_blocks,
-        chunks_user=up.n_chunks,
-        chunks_item=ip.n_chunks,
+        chunks_user=chunks_u,
+        chunks_item=chunks_i,
         precision=p.pallas_precision,
+        mode=mode,
     )
 
     U, V = _init_factors(p, num_users_pad, num_items_pad, num_users,
                          num_items, dtype)
     steps = _make_pallas_step(
-        (up.tiles_per_chunk, up.n_blocks, ip.tiles_per_chunk, ip.n_blocks),
-        p, num_users_pad, num_items_pad,
+        (tiles_u, up.n_blocks, tiles_i, ip.n_blocks),
+        p, num_users_pad, num_items_pad, fused,
     )
     U, V = steps(u_plan, u_oth, u_rat, u_val,
                  i_plan, i_oth, i_rat, i_val, U, V,

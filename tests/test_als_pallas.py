@@ -136,3 +136,59 @@ def test_segment_stats_matches_scatter_semantics():
             acc[:, k * k : k * k + k], b_ref, rtol=1e-4, atol=1e-4
         )
         np.testing.assert_allclose(acc[:, k * k + k], c_ref, rtol=1e-5)
+
+
+def test_segment_stats_fused_matches_scatter_semantics():
+    """The single-grid fused kernel (packed rows built in VMEM) must give
+    the same A/b/counts as the chunked path and the scatter reference."""
+    rng = np.random.default_rng(5)
+    n, nseg, noth, k = 3000, 256, 64, 6
+    seg = rng.integers(0, 250, n)
+    oth = rng.integers(0, noth, n).astype(np.int32)
+    rat = rng.uniform(-2, 2, n).astype(np.float32)
+    factors = rng.standard_normal((noth, k)).astype(np.float32)
+    plan = ap.build_plan(seg.astype(np.int64), nseg)
+    rows = plan.padded_len
+    oth_p = oth[plan.dest_perm].copy()
+    rat_p = rat[plan.dest_perm].copy()
+    val_p = np.ones(rows, np.float32)
+    oth_p[plan.pad_mask] = 0
+    rat_p[plan.pad_mask] = 0
+    val_p[plan.pad_mask] = 0
+
+    for implicit in (False, True):
+        acc = ap.segment_stats_fused(
+            (jnp.asarray(plan.block_map), jnp.asarray(plan.first),
+             jnp.asarray(plan.seg3)),
+            jnp.asarray(oth_p), jnp.asarray(rat_p), jnp.asarray(val_p),
+            jnp.asarray(factors), implicit, 1.5,
+            plan.n_tiles, plan.n_blocks, interpret=True,
+        )
+        acc = np.asarray(acc)[:nseg]
+        v = factors[oth]
+        if implicit:
+            w = 1.5 * np.abs(rat)
+            rhs = (1.0 + w) * (rat > 0)
+        else:
+            w = np.ones(n, np.float32)
+            rhs = rat
+        A_ref = np.zeros((nseg, k, k), np.float32)
+        b_ref = np.zeros((nseg, k), np.float32)
+        c_ref = np.zeros(nseg, np.float32)
+        np.add.at(A_ref, seg, v[:, :, None] * v[:, None, :] * w[:, None, None])
+        np.add.at(b_ref, seg, v * rhs[:, None])
+        np.add.at(c_ref, seg, 1.0)
+        np.testing.assert_allclose(
+            acc[:, : k * k].reshape(nseg, k, k), A_ref, rtol=1e-4, atol=2e-3
+        )
+        np.testing.assert_allclose(
+            acc[:, k * k : k * k + k], b_ref, rtol=1e-4, atol=2e-3
+        )
+        np.testing.assert_allclose(acc[:, k * k + k], c_ref, rtol=1e-5)
+
+
+def test_packed_width():
+    assert ap.packed_width(10) == 16
+    assert ap.packed_width(13) == 16
+    assert ap.packed_width(14) == 32
+    assert ap.packed_width(32) == 48
