@@ -8,10 +8,9 @@ TPU runs with a one-hot MXU formulation that contains NO scatter at all:
      stream lands in exactly ONE ``S``-row block of the accumulator.
   2. DEVICE (per half-step): gather the opposite factors, build the flat
      update rows [P, 128] = [vec(w * v v^T) | rhs*v | valid | 0-pad], and
-     run the pallas kernel: for each tile, an [S, T] one-hot of the local
-     segment ids (built transposed, MXU-natural) is contracted with the
-     [T, W] update tile on the MXU, accumulating into the tile's
-     (VMEM-resident, revisited) output block.
+     run the pallas kernel: for each tile, a [T, S] one-hot of the local
+     segment ids is contracted with the update tile on the MXU,
+     accumulating into the tile's (VMEM-resident, revisited) output block.
 
 Cost is nnz * S * 128 * 2 FLOPs — ~0.65 TFLOP per ML-20M half-step —
 independent of index distribution, versus a TPU scatter that processes one
@@ -127,26 +126,21 @@ def _make_kernel(precision: str):
 
     def kernel(block_map_ref, first_ref, seg_ref, upd_ref, out_ref):
         i = pl.program_id(0)
-        # Build the one-hot TRANSPOSED, [S, T]: segment ids as a lane row
-        # compared against a sublane iota, so both matmul operands sit in
-        # the MXU-natural orientation ([S,T] @ [T,W], T contracting) and
-        # no per-tile relayout of a [T,S] operand is needed.  In-situ
-        # train time is identical to the [T,S] form (the half-step is
-        # bound by the chunk scan's stream + revisited-block DMA, not the
-        # one-hot build), but isolated-kernel runs measure up to 2x and
-        # this form needs no 3D intermediate.
-        seg_row = seg_ref[0].reshape(1, T)  # [1, T] int32
-        oh_t = jax.lax.broadcasted_iota(jnp.int32, (S, T), 0) == seg_row
-        dn = (((1,), (0,)), ((), ()))
+        seg = seg_ref[0]  # [T//128, 128] int32
+        onehot = (
+            seg[:, :, None]
+            == jax.lax.broadcasted_iota(jnp.int32, (T // 128, 128, S), 2)
+        ).astype(jnp.float32).reshape(T, S)
+        dn = (((0,), (0,)), ((), ()))
         upd = upd_ref[:]
         if precision == "highest":
             contrib = jax.lax.dot_general(
-                oh_t.astype(jnp.float32), upd, dimension_numbers=dn,
+                onehot, upd, dimension_numbers=dn,
                 preferred_element_type=jnp.float32,
                 precision=jax.lax.Precision.HIGHEST,
             )
         else:
-            oh16 = oh_t.astype(jnp.bfloat16)
+            oh16 = onehot.astype(jnp.bfloat16)
             hi = upd.astype(jnp.bfloat16)
             contrib = jax.lax.dot_general(
                 oh16, hi, dimension_numbers=dn,
