@@ -716,6 +716,8 @@ def serving_p50_concurrent(model, num_users, clients=32, per_client=40):
 
 
 def main() -> None:
+    import types
+
     import jax
 
     # persistent compile cache: the second bench run on a box skips the
@@ -733,6 +735,30 @@ def main() -> None:
     from predictionio_tpu.ops.als import ALSParams, train_als
     from predictionio_tpu.parallel.mesh import MeshConfig, make_mesh
 
+    # Sectioned run: one failed model path (an HBM OOM on a co-tenanted
+    # chip, a crashed worker) must cost THAT section's numbers, not the
+    # whole round's.  Every section records into `metrics` as soon as a
+    # figure exists; the final JSON line always prints, listing whatever
+    # failed.  PIO_BENCH_FAIL_SECTION=<name> injects a failure at section
+    # entry so the degradation path itself is testable.
+    metrics: dict = {}
+    failed: list = []
+    C = types.SimpleNamespace()
+
+    def run_section(name: str, fn) -> bool:
+        try:
+            if os.environ.get("PIO_BENCH_FAIL_SECTION") == name:
+                raise RuntimeError(
+                    f"injected failure (PIO_BENCH_FAIL_SECTION={name})"
+                )
+            fn()
+            return True
+        except Exception as e:  # noqa: BLE001 — a bench section may die
+            failed.append(name)
+            msg = str(e).split("\n", 1)[0][:300]
+            log(f"# SECTION {name} FAILED ({type(e).__name__}): {msg}")
+            return False
+
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     scale = float(os.environ.get("PIO_BENCH_SCALE", "1.0" if on_tpu else "0.01"))
@@ -742,317 +768,391 @@ def main() -> None:
     num_items = max(int(26_744 * scale), 48)
     budget_s = 60.0 * max(scale, 1e-6)
 
-    t0 = time.perf_counter()
-    user_idx, item_idx, rating = make_movielens_like(nnz, num_users, num_items)
-    (tr_u, tr_i, tr_r), (te_u, te_i) = holdout_split(
-        user_idx, item_idx, rating, np.random.default_rng(7)
-    )
-    log(
-        f"# platform={platform} devices={len(jax.devices())} nnz={nnz} "
-        f"train={len(tr_r)} test={len(te_u)} gen={time.perf_counter()-t0:.1f}s"
-    )
+    def sec_data():
+        t0 = time.perf_counter()
+        user_idx, item_idx, rating = make_movielens_like(
+            nnz, num_users, num_items
+        )
+        (C.tr_u, C.tr_i, C.tr_r), (C.te_u, C.te_i) = holdout_split(
+            user_idx, item_idx, rating, np.random.default_rng(7)
+        )
+        log(
+            f"# platform={platform} devices={len(jax.devices())} nnz={nnz} "
+            f"train={len(C.tr_r)} test={len(C.te_u)} "
+            f"gen={time.perf_counter()-t0:.1f}s"
+        )
 
     n_dev = len(jax.devices())
-    mesh = make_mesh(MeshConfig(axes={"data": n_dev})) if n_dev > 1 else None
-    params = ALSParams(rank=10, reg=0.01, seed=3)
+    C.mesh = make_mesh(MeshConfig(axes={"data": n_dev})) if n_dev > 1 else None
+    C.params = ALSParams(rank=10, reg=0.01, seed=3)
 
-    # Warmup: compile + one epoch (epoch cost tracked on stderr).
-    t0 = time.perf_counter()
-    device_sync(
-        train_als(
-            tr_u, tr_i, tr_r, num_users, num_items,
-            params=ALSParams(rank=10, reg=0.01, seed=3, num_iterations=1),
-            mesh=mesh,
-        ).user_factors
-    )
-    warm_s = time.perf_counter() - t0
+    def sec_als_train():
+        mesh, params = C.mesh, C.params
+        tr_u, tr_i, tr_r = C.tr_u, C.tr_i, C.tr_r
 
-    # COLD train: host staging (sort + block-pad + device upload, the Spark
-    # partition-and-cache role) + the compiled 20-iteration program.  The
-    # staging cache is cleared first so this is a true from-raw-COO number.
-    from predictionio_tpu.ops import als as _als_mod
+        # Warmup: compile + one epoch (epoch cost tracked on stderr).
+        t0 = time.perf_counter()
+        device_sync(
+            train_als(
+                tr_u, tr_i, tr_r, num_users, num_items,
+                params=ALSParams(rank=10, reg=0.01, seed=3, num_iterations=1),
+                mesh=mesh,
+            ).user_factors
+        )
+        warm_s = time.perf_counter() - t0
 
-    _als_mod._STAGE_CACHE.clear()
-    t0 = time.perf_counter()
-    state = train_als(
-        tr_u, tr_i, tr_r, num_users, num_items, params=params, mesh=mesh
-    )
-    device_sync(state.user_factors)
-    train_cold_s = time.perf_counter() - t0
+        # COLD train: host staging (sort + block-pad + device upload, the
+        # Spark partition-and-cache role) + the compiled 20-iteration
+        # program.  The staging cache is cleared first so this is a true
+        # from-raw-COO number.
+        from predictionio_tpu.ops import als as _als_mod
 
-    # WARM trains, MEDIAN of 3 with all runs + spread reported: staged
-    # data reused (retrains/sweeps on the same ratings, the common case),
-    # robust to one co-tenant-noise run without best-of-N cherry-picking
-    train_runs = []
-    for _ in range(3):
+        _als_mod._STAGE_CACHE.clear()
         t0 = time.perf_counter()
         state = train_als(
             tr_u, tr_i, tr_r, num_users, num_items, params=params, mesh=mesh
         )
         device_sync(state.user_factors)
-        train_runs.append(time.perf_counter() - t0)
-    train_s = sorted(train_runs)[1]
-    train_spread = max(train_runs) - min(train_runs)
-    assert np.isfinite(np.asarray(state.user_factors)).all()
-    log(
-        f"# warmup(compile+1ep)={warm_s:.2f}s train(20 iter) "
-        f"cold={train_cold_s:.2f}s warm median={train_s:.2f}s (runs: "
-        + ", ".join(f"{t:.2f}" for t in train_runs)
-        + f", spread={train_spread:.2f}s; cold = staging+train from raw "
-        f"COO, warm = staged-data retrain)"
-    )
+        C.train_cold_s = time.perf_counter() - t0
+        metrics["train_cold_s"] = round(C.train_cold_s, 3)
 
-    # Roofline accounting for the pallas train path (single-device TPU):
-    # HBM bytes and MXU flops per iteration from the actual staged plan,
-    # vs v5e peaks (819 GB/s HBM, ~197 bf16 TFLOP/s MXU), so "where the
-    # time goes" is a measured claim, not a vibe.
-    from predictionio_tpu.ops.als import LAST_PLAN_INFO
-
-    if on_tpu and LAST_PLAN_INFO:
-        pi = LAST_PLAN_INFO
-        width = pi["width"]
-        passes = {"hilo": 2, "bf16": 1, "highest": 6}[pi["precision"]]
-        row_b = width * 4
-        gb = 0.0
-        fl = 0.0
-        for side in ("user", "item"):
-            rows = pi[f"rows_{side}"]
-            # gather factors + write flat rows + kernel reads flat rows
-            gb += rows * (512 + 2 * row_b) / 1e9
-            # per-chunk accumulator read-modify-write over visited blocks
-            gb += (
-                pi[f"chunks_{side}"] * pi[f"blocks_{side}"] * 128 * row_b * 3
-            ) / 1e9
-            fl += 2.0 * rows * 128 * width * passes / 1e12
-        it_s = train_s / params.num_iterations
+        # WARM trains, MEDIAN of 3 with all runs + spread reported: staged
+        # data reused (retrains/sweeps on the same ratings, the common
+        # case), robust to one co-tenant-noise run without best-of-N
+        # cherry-picking
+        train_runs = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            state = train_als(
+                tr_u, tr_i, tr_r, num_users, num_items, params=params,
+                mesh=mesh,
+            )
+            device_sync(state.user_factors)
+            train_runs.append(time.perf_counter() - t0)
+        C.train_s = sorted(train_runs)[1]
+        train_spread = max(train_runs) - min(train_runs)
+        assert np.isfinite(np.asarray(state.user_factors)).all()
+        C.state = state
+        metrics["train_runs_s"] = [round(t, 3) for t in train_runs]
         log(
-            f"# roofline/iter: ~{gb:.1f} GB moved -> {gb / it_s:.0f} GB/s "
-            f"achieved (HBM peak ~819); one-hot MXU {fl:.2f} TFLOP(eq) -> "
-            f"{fl / it_s:.1f} TFLOP/s (bf16 peak ~197); "
-            f"iter={it_s * 1000:.0f} ms — bound by per-nnz gather + "
-            f"in-kernel one-hot build (VPU), not HBM bandwidth or MXU "
-            f"(measured: gather 0.13s + accum 0.24s + solve ~ms per "
-            f"half-step in isolation)"
+            f"# warmup(compile+1ep)={warm_s:.2f}s train(20 iter) "
+            f"cold={C.train_cold_s:.2f}s warm median={C.train_s:.2f}s (runs: "
+            + ", ".join(f"{t:.2f}" for t in train_runs)
+            + f", spread={train_spread:.2f}s; cold = staging+train from raw "
+            f"COO, warm = staged-data retrain)"
         )
 
-    # rank=32 variant: the MXU actually matters at this width
-    # (row_width(32)=1152 lanes, 9x the rank-10 flat row)
-    rank32_iters = 5
-    p32 = ALSParams(rank=32, reg=0.01, seed=3, num_iterations=1)
-    device_sync(
-        train_als(tr_u, tr_i, tr_r, num_users, num_items, params=p32,
-                  mesh=mesh).user_factors
-    )
-    t0 = time.perf_counter()
-    s32 = train_als(
-        tr_u, tr_i, tr_r, num_users, num_items,
-        params=ALSParams(rank=32, reg=0.01, seed=3,
-                         num_iterations=rank32_iters),
-        mesh=mesh,
-    )
-    device_sync(s32.user_factors)
-    rank32_iter_s = (time.perf_counter() - t0) / rank32_iters
-    assert np.isfinite(np.asarray(s32.user_factors)).all()
-    log(f"# rank32 iter={rank32_iter_s:.2f}s ({rank32_iters} iters timed)")
+        # Roofline accounting for the pallas train path (single-device
+        # TPU): HBM bytes and MXU flops per iteration from the actual
+        # staged plan, vs v5e peaks (819 GB/s HBM, ~197 bf16 TFLOP/s MXU),
+        # so "where the time goes" is a measured claim, not a vibe.
+        from predictionio_tpu.ops.als import LAST_PLAN_INFO
 
-    # Distribution-robustness probe: the same kernel on uniformly-sampled
-    # data of identical size.  The pallas one-hot accumulation processes a
-    # fixed tile count regardless of index skew; this line proves it on
-    # every run.  Two-call diff cancels the one-time host prep (sort+pad)
-    # and any compile from the per-epoch figure.
-    rng_u = np.random.default_rng(5)
-    uu = rng_u.integers(0, num_users, len(tr_u)).astype(np.int64)
-    ui = rng_u.integers(0, num_items, len(tr_u)).astype(np.int64)
+        if on_tpu and LAST_PLAN_INFO:
+            pi = LAST_PLAN_INFO
+            width = pi["width"]
+            passes = {"hilo": 2, "bf16": 1, "highest": 6}[pi["precision"]]
+            row_b = width * 4
+            gb = 0.0
+            fl = 0.0
+            for side in ("user", "item"):
+                rows = pi[f"rows_{side}"]
+                # gather factors + write flat rows + kernel reads flat rows
+                gb += rows * (512 + 2 * row_b) / 1e9
+                # per-chunk accumulator read-modify-write on visited blocks
+                gb += (
+                    pi[f"chunks_{side}"] * pi[f"blocks_{side}"] * 128
+                    * row_b * 3
+                ) / 1e9
+                fl += 2.0 * rows * 128 * width * passes / 1e12
+            it_s = C.train_s / C.params.num_iterations
+            metrics["roofline_gb_per_iter"] = round(gb, 2)
+            metrics["roofline_achieved_gb_s"] = round(gb / it_s, 1)
+            metrics["roofline_tflop_eq_per_iter"] = round(fl, 3)
+            metrics["roofline_achieved_tflop_s"] = round(fl / it_s, 2)
+            metrics["als_pallas_mode"] = pi.get("mode", "?")
+            log(
+                f"# roofline/iter: ~{gb:.1f} GB moved -> {gb / it_s:.0f} GB/s "
+                f"achieved (HBM peak ~819); one-hot MXU {fl:.2f} TFLOP(eq) "
+                f"-> {fl / it_s:.1f} TFLOP/s (bf16 peak ~197); "
+                f"iter={it_s * 1000:.0f} ms; mode={pi.get('mode')}"
+            )
 
-    def _timed_uniform(iters):
+    def sec_als_rank32():
+        mesh = C.mesh
+        tr_u, tr_i, tr_r = C.tr_u, C.tr_i, C.tr_r
+        # rank=32 variant: the MXU actually matters at this width
+        # (row_width(32)=1152 lanes, 9x the rank-10 flat row)
+        rank32_iters = 5
+        p32 = ALSParams(rank=32, reg=0.01, seed=3, num_iterations=1)
+        device_sync(
+            train_als(tr_u, tr_i, tr_r, num_users, num_items, params=p32,
+                      mesh=mesh).user_factors
+        )
+        t0 = time.perf_counter()
+        s32 = train_als(
+            tr_u, tr_i, tr_r, num_users, num_items,
+            params=ALSParams(rank=32, reg=0.01, seed=3,
+                             num_iterations=rank32_iters),
+            mesh=mesh,
+        )
+        device_sync(s32.user_factors)
+        rank32_iter_s = (time.perf_counter() - t0) / rank32_iters
+        assert np.isfinite(np.asarray(s32.user_factors)).all()
+        metrics["als_rank32_iter_s"] = round(rank32_iter_s, 3)
+        log(f"# rank32 iter={rank32_iter_s:.2f}s ({rank32_iters} iters timed)")
+
+    def sec_als_uniform():
+        mesh = C.mesh
+        tr_u, tr_r = C.tr_u, C.tr_r
+        # Distribution-robustness probe: the same kernel on uniformly-
+        # sampled data of identical size.  The pallas one-hot accumulation
+        # processes a fixed tile count regardless of index skew; this line
+        # proves it on every run.  Two-call diff cancels the one-time host
+        # prep (sort+pad) and any compile from the per-epoch figure.
+        rng_u = np.random.default_rng(5)
+        uu = rng_u.integers(0, num_users, len(tr_u)).astype(np.int64)
+        ui = rng_u.integers(0, num_items, len(tr_u)).astype(np.int64)
+
+        def _timed_uniform(iters):
+            t0 = time.perf_counter()
+            device_sync(
+                train_als(
+                    uu, ui, tr_r, num_users, num_items,
+                    params=ALSParams(rank=10, reg=0.01, seed=3,
+                                     num_iterations=iters),
+                    mesh=mesh,
+                ).user_factors
+            )
+            return time.perf_counter() - t0
+
+        _timed_uniform(1)  # compile for these shapes
+        t1 = _timed_uniform(1)
+        t5 = _timed_uniform(5)
+        ep_uniform = max(t5 - t1, 0.0) / 4
+        skew = (
+            f"{C.train_s / C.params.num_iterations:.2f}s"
+            if hasattr(C, "train_s") else "n/a"
+        )
+        log(
+            f"# epoch_time skewed={skew} uniform={ep_uniform:.2f}s "
+            f"(distribution-robustness; prep+compile excluded via "
+            f"two-call diff)"
+        )
+
+    def sec_als_quality():
+        mesh = C.mesh
+        tr_u, tr_i, tr_r = C.tr_u, C.tr_i, C.tr_r
+        # Quality probe: top-N ranking MAP@10.  Explicit rating-prediction
+        # ALS is a poor top-N ranker (well known); the ranking-quality
+        # number tracked by BASELINE uses implicit-feedback ALS on binary
+        # positives (rating >= 4, the reference templates' train-with-
+        # rate-event thresholding), vs a popularity baseline for context.
+        # Untimed — the timed headline above keeps reference hyperparams.
+        t0 = time.perf_counter()
+        pos_mask = tr_r >= 4.0
+        C.pos_mask = pos_mask
+        imp = train_als(
+            tr_u[pos_mask], tr_i[pos_mask],
+            np.ones(int(pos_mask.sum()), np.float32),
+            num_users, num_items,
+            params=ALSParams(
+                rank=10, num_iterations=20, reg=0.01, seed=3,
+                implicit_prefs=True, alpha=2.0, chunk_size=1 << 18,
+            ),
+            mesh=mesh,
+        )
+        device_sync(imp.user_factors)
+        imp_train_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        C.map10, C.prec10, n_eval = compute_ranking_metrics(
+            np.asarray(imp.user_factors), np.asarray(imp.item_factors),
+            tr_u, tr_i, C.te_u, C.te_i,
+        )
+        pop = np.bincount(tr_i, minlength=num_items).astype(np.float32)
+        C.map_pop, C.prec_pop, _ = compute_ranking_metrics(
+            np.ones((num_users, 1), np.float32),
+            pop[:, None],
+            tr_u, tr_i, C.te_u, C.te_i,
+            max_eval_users=4000,
+        )
+        metrics["map_at_10"] = round(C.map10, 4)
+        metrics["precision_at_10"] = round(C.prec10, 4)
+        metrics["map_at_10_popularity_baseline"] = round(C.map_pop, 4)
+        log(
+            f"# MAP@10={C.map10:.4f} Precision@10={C.prec10:.4f} "
+            f"eval_users={n_eval} popularity-baseline MAP@10={C.map_pop:.4f} "
+            f"P@10={C.prec_pop:.4f} implicit_train={imp_train_s:.1f}s "
+            f"metrics={time.perf_counter()-t0:.1f}s"
+        )
+
+    def sec_ncf():
+        mesh = C.mesh
+        tr_u, tr_i, tr_r = C.tr_u, C.tr_i, C.tr_r
+        # NCF flagship: epochs/s on the on-device pipeline (one XLA
+        # dispatch per epoch: device-side shuffle + in-step negative
+        # sampling + lax.scan), ranking quality on the same held-out split
+        # as the ALS number, and serving p50 through the NCF template's
+        # predict path.
+        from predictionio_tpu.ops.ncf import NCFParams, train_ncf
+
+        pos_mask = getattr(C, "pos_mask", None)
+        if pos_mask is None:
+            pos_mask = tr_r >= 4.0
+        ncf_u = tr_u[pos_mask].astype(np.int32)
+        ncf_i = tr_i[pos_mask].astype(np.int32)
+        # Config notes from the round-3/4 sweeps on this generator:
+        # - popularity-smoothed negatives (neg_power=0.75) CRATER MAP
+        #   (0.003 vs 0.022): held-out positives are popularity-driven, so
+        #   harder negatives teach the model to rank popular items down.
+        #   neg_power stays available as an engine param for real-world
+        #   catalogs.
+        # - loss/K sweep (round 4): bpr-k1 0.0223, bpr-k8 0.0224,
+        #   softmax-k8 0.0226 (±bias identical) — sampled-negative SGD
+        #   plateaus ~0.0225 here regardless of loss shape, vs implicit-
+        #   ALS 0.0307 on the SAME binary positives (implicit ALS solves
+        #   whole-catalog weighted least squares per user, which sampled
+        #   objectives only approximate).  The bench keeps the fastest
+        #   plateau config (bpr, K=1, item_bias).
+        ncf_cfg = dict(embed_dim=32, batch_size=8192, neg_power=0.0, seed=3)
         t0 = time.perf_counter()
         device_sync(
-            train_als(
-                uu, ui, tr_r, num_users, num_items,
-                params=ALSParams(rank=10, reg=0.01, seed=3,
-                                 num_iterations=iters),
-                mesh=mesh,
-            ).user_factors
+            train_ncf(ncf_u, ncf_i, num_users, num_items,
+                      params=NCFParams(num_epochs=1, **ncf_cfg),
+                      mesh=mesh).params["out_b"]
         )
-        return time.perf_counter() - t0
-
-    _timed_uniform(1)  # compile for these shapes
-    t1 = _timed_uniform(1)
-    t5 = _timed_uniform(5)
-    ep_uniform = max(t5 - t1, 0.0) / 4
-    log(
-        f"# epoch_time skewed={train_s / params.num_iterations:.2f}s "
-        f"uniform={ep_uniform:.2f}s (distribution-robustness; prep+compile "
-        f"excluded via two-call diff)"
-    )
-
-    # Quality probe: top-N ranking MAP@10.  Explicit rating-prediction ALS is
-    # a poor top-N ranker (well known); the ranking-quality number tracked by
-    # BASELINE uses implicit-feedback ALS on binary positives (rating >= 4,
-    # the reference templates' train-with-rate-event thresholding), vs a
-    # popularity baseline for context.  Untimed — the timed headline above
-    # keeps reference hyperparams.
-    t0 = time.perf_counter()
-    pos_mask = tr_r >= 4.0
-    imp = train_als(
-        tr_u[pos_mask], tr_i[pos_mask],
-        np.ones(int(pos_mask.sum()), np.float32),
-        num_users, num_items,
-        params=ALSParams(
-            rank=10, num_iterations=20, reg=0.01, seed=3,
-            implicit_prefs=True, alpha=2.0, chunk_size=1 << 18,
-        ),
-        mesh=mesh,
-    )
-    device_sync(imp.user_factors)
-    imp_train_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    map10, prec10, n_eval = compute_ranking_metrics(
-        np.asarray(imp.user_factors), np.asarray(imp.item_factors),
-        tr_u, tr_i, te_u, te_i,
-    )
-    pop = np.bincount(tr_i, minlength=num_items).astype(np.float32)
-    map_pop, prec_pop, _ = compute_ranking_metrics(
-        np.ones((num_users, 1), np.float32),
-        pop[:, None],
-        tr_u, tr_i, te_u, te_i,
-        max_eval_users=4000,
-    )
-    log(
-        f"# MAP@10={map10:.4f} Precision@10={prec10:.4f} eval_users={n_eval} "
-        f"popularity-baseline MAP@10={map_pop:.4f} P@10={prec_pop:.4f} "
-        f"implicit_train={imp_train_s:.1f}s metrics={time.perf_counter()-t0:.1f}s"
-    )
-
-    # NCF flagship: epochs/s on the on-device pipeline (one XLA dispatch per
-    # epoch: device-side shuffle + in-step negative sampling + lax.scan),
-    # ranking quality on the same held-out split as the ALS number, and
-    # serving p50 through the NCF template's predict path.
-    from predictionio_tpu.ops.ncf import NCFParams, train_ncf
-
-    ncf_u = tr_u[pos_mask].astype(np.int32)
-    ncf_i = tr_i[pos_mask].astype(np.int32)
-    # Config notes from the round-3/4 sweeps on this generator:
-    # - popularity-smoothed negatives (neg_power=0.75) CRATER MAP (0.003
-    #   vs 0.022): held-out positives are popularity-driven, so harder
-    #   negatives teach the model to rank popular items down.  neg_power
-    #   stays available as an engine param for real-world catalogs.
-    # - loss/K sweep (round 4): bpr-k1 0.0223, bpr-k8 0.0224, softmax-k8
-    #   0.0226 (±bias identical) — sampled-negative SGD plateaus ~0.0225
-    #   here regardless of loss shape, vs implicit-ALS 0.0307 on the SAME
-    #   binary positives (implicit ALS solves whole-catalog weighted least
-    #   squares per user, which sampled objectives only approximate).  The
-    #   bench keeps the fastest plateau config (bpr, K=1, item_bias).
-    ncf_cfg = dict(embed_dim=32, batch_size=8192, neg_power=0.0, seed=3)
-    t0 = time.perf_counter()
-    device_sync(
-        train_ncf(ncf_u, ncf_i, num_users, num_items,
-                  params=NCFParams(num_epochs=1, **ncf_cfg),
-                  mesh=mesh).params["out_b"]
-    )
-    ncf_warm_s = time.perf_counter() - t0
-    # quality train: enough epochs to converge MAP (plateaus ~12 on this
-    # dataset); the same run provides the epochs/s throughput figure
-    ncf_epochs = 12
-    t0 = time.perf_counter()
-    ncf_state = train_ncf(
-        ncf_u, ncf_i, num_users, num_items,
-        params=NCFParams(num_epochs=ncf_epochs, **ncf_cfg), mesh=mesh)
-    device_sync(ncf_state.params["out_b"])
-    ncf_eps = ncf_epochs / (time.perf_counter() - t0)
-    log(
-        f"# ncf warmup={ncf_warm_s:.1f}s epochs_per_s={ncf_eps:.3f} "
-        f"(positives={len(ncf_u)} users={num_users} items={num_items} "
-        f"d=32 bs=8192 uniform-negatives epochs={ncf_epochs})"
-    )
-    t0 = time.perf_counter()
-    ncf_map10, ncf_prec10, ncf_n_eval = ncf_ranking_metrics(
-        ncf_state.params, tr_u, tr_i, te_u, te_i, num_items
-    )
-    log(
-        f"# ncf MAP@10={ncf_map10:.4f} P@10={ncf_prec10:.4f} "
-        f"eval_users={ncf_n_eval} (vs als {map10:.4f}/{prec10:.4f}, "
-        f"popularity {map_pop:.4f}/{prec_pop:.4f}; "
-        f"metrics={time.perf_counter() - t0:.1f}s)"
-    )
-    from predictionio_tpu.models.ncf.engine import _score_topk_batch
-
-    ncf_model = build_ncf_model(ncf_state, num_users, num_items)
-    rtt_ms = tunnel_rtt_ms()
-    ncf_p50 = ncf_serving_p50(ncf_model, num_users, n=60)
-    ncf_dev_ms = ncf_solo_device_ms(ncf_state.params, num_items, num_users)
-    # device-level wave cost: 50 DISTINCT 32-query micro-batch waves
-    # dispatched back-to-back with one final sync — pipelining amortizes
-    # this dev box's ~100 ms tunnel round trip out of the measurement, so
-    # the per-wave figure approximates what a production TPU-VM serving
-    # path pays per wave of 32 queries
-    import jax.numpy as _jnp
-
-    waves = [
-        _jnp.asarray((np.arange(32) * 131 + w * 37) % num_users, _jnp.int32)
-        for w in range(51)
-    ]
-    device_sync(_score_topk_batch(ncf_state.params, waves[0], num_items, K)[0])
-    t0 = time.perf_counter()
-    outs = [
-        _score_topk_batch(ncf_state.params, w, num_items, K)
-        for w in waves[1:]
-    ]
-    # in-order single-device queue: the LAST wave's value arriving proves
-    # all 50 executed (block_until_ready alone can return early here)
-    device_sync(outs[-1][0])
-    ncf_wave32_ms = (time.perf_counter() - t0) / 50 * 1000
-    log(
-        f"# ncf serving: solo wall p50={ncf_p50:.1f}ms of which tunnel RTT "
-        f"p50={rtt_ms:.1f}ms; solo DEVICE cost={ncf_dev_ms:.2f}ms/query "
-        f"(pipelined, target <10ms) wave32_pipelined={ncf_wave32_ms:.3f}ms "
-        f"(~{ncf_wave32_ms / 32:.3f}ms/query batched)"
-    )
-
-    # 20M-event store proof: the full event-data plane at benchmark scale —
-    # bulk columnar write into the sharded parquet store, entity-hash shard
-    # scan back out, and an ALS iteration trained from the scanned columns
-    # (the PEventStore seam end to end, VERDICT r3 "prove parquet at scale")
-    store_stats = bench_event_store_20m(tr_u, tr_i, tr_r, num_users, num_items)
-
-    model = build_als_model(state, num_users, num_items)
-    p50_single = serving_p50_single(model, num_users)
-    p50_conc, p99_conc = serving_p50_concurrent(model, num_users)
-    log(
-        f"# serving_p50={p50_single:.3f}ms "
-        f"serving_p50_concurrent32={p50_conc:.3f}ms "
-        f"p99_concurrent32={p99_conc:.3f}ms (target <10ms)"
-    )
-
-    print(
-        json.dumps(
-            {
-                "metric": "als_ml20m_train_time"
-                if scale == 1.0
-                else f"als_ml20m_train_time_scale{scale:g}",
-                "value": round(train_s, 3),
-                "unit": "s",
-                "vs_baseline": round(budget_s / train_s, 3),
-                "train_cold_s": round(train_cold_s, 3),
-                "train_runs_s": [round(t, 3) for t in train_runs],
-                "als_rank32_iter_s": round(rank32_iter_s, 3),
-                "map_at_10": round(map10, 4),
-                "precision_at_10": round(prec10, 4),
-                "map_at_10_popularity_baseline": round(map_pop, 4),
-                "serving_p50_ms": round(p50_single, 3),
-                "serving_p50_concurrent32_ms": round(p50_conc, 3),
-                "serving_p99_concurrent32_ms": round(p99_conc, 3),
-                "tunnel_rtt_ms": round(rtt_ms, 3),
-                "ncf_epochs_per_s": round(ncf_eps, 4),
-                "ncf_map_at_10": round(ncf_map10, 4),
-                "ncf_precision_at_10": round(ncf_prec10, 4),
-                "ncf_serving_p50_ms": round(ncf_p50, 3),
-                "ncf_solo_device_ms": round(ncf_dev_ms, 3),
-                "ncf_wave32_pipelined_ms": round(ncf_wave32_ms, 3),
-                **store_stats,
-            }
+        ncf_warm_s = time.perf_counter() - t0
+        # quality train: enough epochs to converge MAP (plateaus ~12 on
+        # this dataset); the same run provides the epochs/s throughput
+        ncf_epochs = 12
+        t0 = time.perf_counter()
+        ncf_state = train_ncf(
+            ncf_u, ncf_i, num_users, num_items,
+            params=NCFParams(num_epochs=ncf_epochs, **ncf_cfg), mesh=mesh)
+        device_sync(ncf_state.params["out_b"])
+        C.ncf_state = ncf_state
+        ncf_eps = ncf_epochs / (time.perf_counter() - t0)
+        metrics["ncf_epochs_per_s"] = round(ncf_eps, 4)
+        log(
+            f"# ncf warmup={ncf_warm_s:.1f}s epochs_per_s={ncf_eps:.3f} "
+            f"(positives={len(ncf_u)} users={num_users} items={num_items} "
+            f"d=32 bs=8192 uniform-negatives epochs={ncf_epochs})"
         )
-    )
+        t0 = time.perf_counter()
+        ncf_map10, ncf_prec10, ncf_n_eval = ncf_ranking_metrics(
+            ncf_state.params, tr_u, tr_i, C.te_u, C.te_i, num_items
+        )
+        metrics["ncf_map_at_10"] = round(ncf_map10, 4)
+        metrics["ncf_precision_at_10"] = round(ncf_prec10, 4)
+        als_q = (
+            f"{C.map10:.4f}/{C.prec10:.4f}" if hasattr(C, "map10") else "n/a"
+        )
+        pop_q = (
+            f"{C.map_pop:.4f}/{C.prec_pop:.4f}"
+            if hasattr(C, "map_pop") else "n/a"
+        )
+        log(
+            f"# ncf MAP@10={ncf_map10:.4f} P@10={ncf_prec10:.4f} "
+            f"eval_users={ncf_n_eval} (vs als {als_q}, popularity {pop_q}; "
+            f"metrics={time.perf_counter() - t0:.1f}s)"
+        )
+
+    def sec_ncf_serving():
+        from predictionio_tpu.models.ncf.engine import _score_topk_batch
+
+        ncf_state = C.ncf_state
+        ncf_model = build_ncf_model(ncf_state, num_users, num_items)
+        rtt_ms = tunnel_rtt_ms()
+        metrics["tunnel_rtt_ms"] = round(rtt_ms, 3)
+        ncf_p50 = ncf_serving_p50(ncf_model, num_users, n=60)
+        ncf_dev_ms = ncf_solo_device_ms(ncf_state.params, num_items,
+                                        num_users)
+        metrics["ncf_serving_p50_ms"] = round(ncf_p50, 3)
+        metrics["ncf_solo_device_ms"] = round(ncf_dev_ms, 3)
+        # device-level wave cost: 50 DISTINCT 32-query micro-batch waves
+        # dispatched back-to-back with one final sync — pipelining
+        # amortizes this dev box's ~100 ms tunnel round trip out of the
+        # measurement, so the per-wave figure approximates what a
+        # production TPU-VM serving path pays per wave of 32 queries
+        import jax.numpy as _jnp
+
+        waves = [
+            _jnp.asarray((np.arange(32) * 131 + w * 37) % num_users,
+                         _jnp.int32)
+            for w in range(51)
+        ]
+        device_sync(
+            _score_topk_batch(ncf_state.params, waves[0], num_items, K)[0]
+        )
+        t0 = time.perf_counter()
+        outs = [
+            _score_topk_batch(ncf_state.params, w, num_items, K)
+            for w in waves[1:]
+        ]
+        # in-order single-device queue: the LAST wave's value arriving
+        # proves all 50 executed (block_until_ready alone can return early)
+        device_sync(outs[-1][0])
+        ncf_wave32_ms = (time.perf_counter() - t0) / 50 * 1000
+        metrics["ncf_wave32_pipelined_ms"] = round(ncf_wave32_ms, 3)
+        log(
+            f"# ncf serving: solo wall p50={ncf_p50:.1f}ms of which tunnel "
+            f"RTT p50={rtt_ms:.1f}ms; solo DEVICE cost={ncf_dev_ms:.2f}"
+            f"ms/query (pipelined, target <10ms) "
+            f"wave32_pipelined={ncf_wave32_ms:.3f}ms "
+            f"(~{ncf_wave32_ms / 32:.3f}ms/query batched)"
+        )
+
+    def sec_event_store():
+        # 20M-event store proof: the full event-data plane at benchmark
+        # scale — bulk columnar write into the sharded parquet store,
+        # entity-hash shard scan back out, and an ALS iteration trained
+        # from the scanned columns (the PEventStore seam end to end)
+        metrics.update(
+            bench_event_store_20m(C.tr_u, C.tr_i, C.tr_r, num_users,
+                                  num_items)
+        )
+
+    def sec_als_serving():
+        model = build_als_model(C.state, num_users, num_items)
+        p50_single = serving_p50_single(model, num_users)
+        p50_conc, p99_conc = serving_p50_concurrent(model, num_users)
+        metrics["serving_p50_ms"] = round(p50_single, 3)
+        metrics["serving_p50_concurrent32_ms"] = round(p50_conc, 3)
+        metrics["serving_p99_concurrent32_ms"] = round(p99_conc, 3)
+        log(
+            f"# serving_p50={p50_single:.3f}ms "
+            f"serving_p50_concurrent32={p50_conc:.3f}ms "
+            f"p99_concurrent32={p99_conc:.3f}ms (target <10ms)"
+        )
+
+    if run_section("data", sec_data):
+        run_section("als_train", sec_als_train)
+        run_section("als_rank32", sec_als_rank32)
+        run_section("als_uniform", sec_als_uniform)
+        run_section("als_quality", sec_als_quality)
+        if run_section("ncf", sec_ncf):
+            run_section("ncf_serving", sec_ncf_serving)
+        run_section("event_store", sec_event_store)
+        if hasattr(C, "state"):
+            run_section("als_serving", sec_als_serving)
+        else:
+            failed.append("als_serving")
+            log("# SECTION als_serving SKIPPED: no trained ALS state")
+
+    train_s = getattr(C, "train_s", None)
+    out = {
+        "metric": "als_ml20m_train_time"
+        if scale == 1.0
+        else f"als_ml20m_train_time_scale{scale:g}",
+        "value": round(train_s, 3) if train_s is not None else None,
+        "unit": "s",
+        "vs_baseline": round(budget_s / train_s, 3)
+        if train_s is not None else None,
+    }
+    out.update(metrics)
+    if failed:
+        out["failed_sections"] = failed
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -329,6 +329,22 @@ def frame_shard_of(
     return shard_of_uniq[inv]
 
 
+def _coerce_numeric(v) -> float | None:
+    """The ``float(props[name])`` coercion contract of the row-wise engine
+    loops: ints/floats pass, bools become 0/1, numeric strings parse;
+    everything else is not-a-number (None)."""
+    if isinstance(v, bool):
+        return float(v)
+    if isinstance(v, (int, float)):
+        return float(v)
+    if isinstance(v, str):
+        try:
+            return float(v)
+        except ValueError:
+            return None
+    return None
+
+
 # ---------------------------------------------------------------------------
 # Event DAOs
 # ---------------------------------------------------------------------------
@@ -566,14 +582,18 @@ class EventFrame:
     def property_column(
         self, name: str, default: float = np.nan, dtype=np.float32
     ) -> np.ndarray:
+        """One numeric property as a float column.  Numeric JSON strings
+        ("4.5") and bools coerce the way the row-wise engine loops always
+        did via ``float(props[name])`` — stored event data keeps training
+        identically whichever path reads it."""
         # branch on row kind FIRST (a cheap isinstance sweep) so a lazy
         # row late in a mostly-dict frame doesn't waste a full eager fill
         if any(isinstance(p, str) for p in self.properties):
             return self._lazy_property_column(name, default, dtype)
         out = np.full(len(self), default, dtype=dtype)
         for i, p in enumerate(self.properties):
-            v = p.get(name) if p else None
-            if isinstance(v, (int, float)) and not isinstance(v, bool):
+            v = _coerce_numeric(p.get(name) if p else None)
+            if v is not None:
                 out[i] = v
         return out
 
@@ -607,11 +627,24 @@ class EventFrame:
             if name not in table.column_names:
                 return out
             col = table.column(name)
-            if not (
-                pa.types.is_integer(col.type) or pa.types.is_floating(col.type)
-            ):  # bools/strings/objects don't count as numeric properties
+            if pa.types.is_integer(col.type) or pa.types.is_floating(col.type):
+                vals = col.to_numpy(zero_copy_only=False).astype(np.float64)
+            elif pa.types.is_boolean(col.type) or pa.types.is_string(
+                col.type
+            ) or pa.types.is_large_string(col.type):
+                # mixed/typed-as-string columns: per-value coercion keeps
+                # "4.5"/true rows training like the old float(props[name])
+                raw = col.to_pylist()
+                vals = np.fromiter(
+                    (
+                        v if (v := _coerce_numeric(r)) is not None else np.nan
+                        for r in raw
+                    ),
+                    np.float64,
+                    len(raw),
+                )
+            else:  # objects/lists don't count as numeric properties
                 return out
-            vals = col.to_numpy(zero_copy_only=False).astype(np.float64)
         except (pa.ArrowException, ValueError, TypeError):
             return self._rowwise_property_column(name, out)
         mask = ~np.isnan(vals)
@@ -630,8 +663,8 @@ class EventFrame:
                     continue  # junk row -> no properties
             else:
                 d = p
-            v = d.get(name) if isinstance(d, dict) else None
-            if isinstance(v, (int, float)) and not isinstance(v, bool):
+            v = _coerce_numeric(d.get(name) if isinstance(d, dict) else None)
+            if v is not None:
                 out[i] = v
         return out
 

@@ -219,11 +219,13 @@ class RemoteClient:
         send; send-phase failures retry regardless (the daemon never saw a
         complete framed request)."""
         q = dict(params or {})
-        if self.auth_key is not None:
-            q["accessKey"] = self.auth_key
         if q:
             path = f"{path}?{urlencode(q)}"
         headers = {"Content-Type": content_type} if body is not None else {}
+        if self.auth_key is not None:
+            # header, not query param: keys in URLs land in proxy/access
+            # logs; the daemon accepts both but prefers Authorization
+            headers["Authorization"] = f"Bearer {self.auth_key}"
         if idempotent is None:
             idempotent = method in _IDEMPOTENT
         _net_errors = (

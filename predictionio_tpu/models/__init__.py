@@ -14,6 +14,7 @@ EngineFactory discovery analog, workflow/WorkflowUtils.scala:47).
 from predictionio_tpu.models import (  # noqa: F401
     classification,
     ecommerce,
+    external,
     ncf,
     recommendation,
     similarproduct,

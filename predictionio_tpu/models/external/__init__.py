@@ -1,0 +1,21 @@
+"""Generic external-model engine (the reference PythonEngine role)."""
+
+from predictionio_tpu.models.external.engine import (
+    ExternalAlgorithm,
+    ExternalDataSource,
+    ExternalServing,
+    PredictedResult,
+    default_engine_params,
+    external_engine,
+    register_external_model,
+)
+
+__all__ = [
+    "ExternalAlgorithm",
+    "ExternalDataSource",
+    "ExternalServing",
+    "PredictedResult",
+    "default_engine_params",
+    "external_engine",
+    "register_external_model",
+]

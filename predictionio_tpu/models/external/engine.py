@@ -1,0 +1,242 @@
+"""Generic external-model engine: serve a model trained OUTSIDE the
+framework through the full DASE deploy/serving stack.
+
+The reference's ``PythonEngine``
+(e2/src/main/scala/org/apache/predictionio/e2/engine/PythonEngine.scala:31-96)
+wraps an externally-trained Spark ``PipelineModel``: the data path is empty,
+``PythonEngine.models(model)`` serializes the pipeline for the model store,
+and ``PythonAlgorithm.predict`` turns the free-form query map into a
+one-row DataFrame, runs the pipeline, and selects the engine.json-declared
+output columns (``PythonServing.supplement`` injects the column list into
+the query, PythonEngine.scala:69-73).
+
+The TPU-native counterpart accepts any picklable Python model:
+
+- **sklearn-style**: an object with ``predict(X)`` (and optionally
+  ``predict_proba(X)``); the feature row is built from the query dict in
+  ``feature_columns`` order, mirroring the reference's schema-from-query
+  DataFrame construction (PythonEngine.scala:83-90).
+- **callable**: any ``model(query_dict) -> dict | scalar`` — the fully
+  general form (a flax apply closure, a torch module wrapper, a rules
+  function).
+
+Register with :func:`register_external_model` (the
+``PythonEngine.models`` + engine-instance bookkeeping role), then deploy
+and query like any template::
+
+    clf = sklearn_fit(...)                       # outside the framework
+    register_external_model(clf, feature_columns=("a", "b"),
+                            columns=("prediction",), storage=storage)
+    server = create_prediction_server("external", storage=storage)
+    # POST /queries.json {"a": 1.0, "b": 2.0} -> {"prediction": ...}
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Mapping
+
+import numpy as np
+
+from predictionio_tpu.core.base import (
+    Algorithm,
+    DataSource,
+    EngineContext,
+    IdentityPreparator,
+    Serving,
+)
+from predictionio_tpu.core.engine import Engine, EngineParams, engine_factory
+
+#: query key carrying the serving-declared output columns into predict —
+#: the ``PythonServing.columns`` constant (PythonEngine.scala:66)
+SELECT_COLUMNS_KEY = "__pio_select_columns__"
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    """The selected output columns as a plain mapping (the reference
+    returns the selected spark Row, PythonEngine.scala:92-95)."""
+
+    values: Mapping[str, Any]
+
+    def to_json_dict(self) -> dict[str, Any]:
+        return dict(self.values)
+
+
+class ExternalTrainingData:
+    """Nothing to read: the model arrives via register_external_model
+    (EmptyTrainingData, PythonEngine.scala:54-56)."""
+
+
+class ExternalDataSource(DataSource):
+    def read_training(self, ctx: EngineContext) -> ExternalTrainingData:
+        return ExternalTrainingData()
+
+
+@dataclass(frozen=True)
+class ExternalAlgorithmParams:
+    #: query-dict keys forming the model's feature row, in order; empty
+    #: means the model is a callable that takes the raw query dict
+    feature_columns: tuple = ()
+
+    params_aliases = {"featureColumns": "feature_columns"}
+
+
+class ExternalAlgorithm(Algorithm):
+    """Serve the registered model.  ``train`` is deliberately unsupported —
+    the whole point is that training happened elsewhere (the reference's
+    ``train = ???``, PythonEngine.scala:78)."""
+
+    flavor = "L"
+    params_class = ExternalAlgorithmParams
+
+    def __init__(self, params: ExternalAlgorithmParams | None = None):
+        self.params = params or ExternalAlgorithmParams()
+
+    def train(self, ctx: EngineContext, pd) -> Any:
+        raise RuntimeError(
+            "the external engine does not train: fit your model outside "
+            "the framework and register it with "
+            "predictionio_tpu.models.external.register_external_model"
+        )
+
+    def _run_model(self, model: Any, features: dict) -> dict:
+        cols = tuple(self.params.feature_columns)
+        if not cols and not callable(model):
+            raise ValueError(
+                "external model is not callable and no feature_columns "
+                "are declared; set algorithm params "
+                '{"featureColumns": [...]} to build sklearn-style rows'
+            )
+        if cols and hasattr(model, "predict"):
+            x = np.asarray(
+                [[float(features[c]) for c in cols]], dtype=np.float64
+            )
+            out = {"prediction": np.asarray(model.predict(x)).reshape(-1)[0]}
+            if hasattr(model, "predict_proba"):
+                out["probability"] = (
+                    np.asarray(model.predict_proba(x))[0].tolist()
+                )
+            return out
+        result = model(dict(features))
+        if not isinstance(result, Mapping):
+            result = {"prediction": result}
+        return dict(result)
+
+    def predict(self, model: Any, query: dict) -> PredictedResult:
+        q = dict(query)
+        select = q.pop(SELECT_COLUMNS_KEY, None)
+        out = self._run_model(model, q)
+        if select:
+            missing = [c for c in select if c not in out]
+            if missing:
+                raise KeyError(
+                    f"external model output {sorted(out)} lacks declared "
+                    f"columns {missing}"
+                )
+            out = {c: out[c] for c in select}
+        return PredictedResult(values=_jsonable(out))
+
+
+def _jsonable(d: dict) -> dict:
+    out = {}
+    for k, v in d.items():
+        if isinstance(v, np.generic):
+            v = v.item()
+        elif isinstance(v, np.ndarray):
+            v = v.tolist()
+        out[k] = v
+    return out
+
+
+@dataclass(frozen=True)
+class ExternalServingParams:
+    #: output columns the engine returns (PythonServing.Params.columns,
+    #: PythonEngine.scala:67)
+    columns: tuple = ("prediction",)
+
+
+class ExternalServing(Serving):
+    params_class = ExternalServingParams
+
+    def __init__(self, params: ExternalServingParams | None = None):
+        self.params = params or ExternalServingParams()
+
+    def supplement(self, query: dict) -> dict:
+        q = dict(query)
+        q[SELECT_COLUMNS_KEY] = tuple(self.params.columns)
+        return q
+
+    def serve(self, query: dict, predictions: list) -> PredictedResult:
+        return predictions[0]
+
+
+@engine_factory("external")
+def external_engine() -> Engine:
+    return Engine(
+        ExternalDataSource,
+        IdentityPreparator,
+        {"default": ExternalAlgorithm},
+        ExternalServing,
+    )
+
+
+def default_engine_params(
+    feature_columns=(), columns=("prediction",)
+) -> EngineParams:
+    return EngineParams(
+        datasource=("", None),
+        preparator=("", None),
+        algorithms=(
+            (
+                "default",
+                ExternalAlgorithmParams(
+                    feature_columns=tuple(feature_columns)
+                ),
+            ),
+        ),
+        serving=("", ExternalServingParams(columns=tuple(columns))),
+    )
+
+
+def register_external_model(
+    model: Any,
+    *,
+    feature_columns=(),
+    columns=("prediction",),
+    storage=None,
+    engine_id: str = "default",
+    engine_version: str = "default",
+    engine_variant: str = "default",
+) -> "EngineInstance":
+    """Persist an externally-trained model as a COMPLETED engine instance.
+
+    The ``PythonEngine.models(model)`` + pypio instance-bookkeeping role
+    (PythonEngine.scala:44-48): after this, ``pio deploy`` /
+    ``deploy_engine("external", ...)`` serves the model like any trained
+    template, and ``pio batchpredict`` scores files with it.
+    """
+    import uuid
+    from datetime import datetime, timezone
+
+    from predictionio_tpu.core.persistence import save_models
+    from predictionio_tpu.data.storage.base import EngineInstance
+    from predictionio_tpu.data.storage.config import get_storage
+
+    storage = storage or get_storage()
+    params = default_engine_params(feature_columns, columns)
+    now = datetime.now(timezone.utc)
+    instance = EngineInstance(
+        id=uuid.uuid4().hex,
+        status="COMPLETED",
+        start_time=now,
+        end_time=now,
+        engine_id=engine_id,
+        engine_version=engine_version,
+        engine_variant=engine_variant,
+        engine_factory="external",
+        **params.to_json_fields(),
+    )
+    storage.engine_instances().insert(instance)
+    save_models(storage.models(), instance.id, [model])
+    return instance

@@ -262,12 +262,17 @@ def _use_pallas(p: "ALSParams") -> bool:
 
 
 def _make_pallas_step(
-    key_shapes, p: ALSParams, num_users_pad, num_items_pad, fused: bool
+    key_shapes, p: ALSParams, num_users_pad, num_items_pad, fused: bool,
+    single_step: bool = False,
 ):
-    """Jitted one-iteration fn over pre-planned (sorted+padded) streams."""
+    """Jitted one-iteration fn over pre-planned (sorted+padded) streams.
+
+    ``single_step`` compiles a straight-line one-iteration program (no
+    fori_loop): last rung of the OOM ladder, because the while-loop's
+    loop-carried remat copies are what the padded-layout blowup bites."""
     key = ("pallas", key_shapes, num_users_pad, num_items_pad, p.rank, p.reg,
            p.implicit_prefs, p.alpha, p.scale_reg_with_count,
-           p.pallas_precision, fused)
+           p.pallas_precision, fused, single_step)
     cached = _STEP_CACHE.get(key)
     if cached is not None:
         return cached
@@ -300,25 +305,39 @@ def _make_pallas_step(
         )
         return _solve_factors(A, b, counts, p.reg, p.scale_reg_with_count, gram)
 
-    @jax.jit
-    def steps(u_plan, u_oth, u_rat, u_val,
-              i_plan, i_oth, i_rat, i_val, U, V, n_iters):
-        """ALL iterations inside one compiled program (lax.fori_loop with a
-        dynamic trip count, so num_iterations stays out of the compile
-        key).  One host dispatch per train instead of one per iteration —
-        on a remote-tunneled device each dispatch costs a ~100 ms round
-        trip, which at 20 iterations was a measurable slice of the whole
-        train."""
+    if single_step:
 
-        def body(_, uv):
-            U, V = uv
+        @jax.jit
+        def steps(u_plan, u_oth, u_rat, u_val,
+                  i_plan, i_oth, i_rat, i_val, U, V, n_iters):
+            del n_iters  # one iteration per dispatch, caller loops
             U = half(u_plan, u_oth, u_rat, u_val, V, tpcu, nbu,
                      num_users_pad)
             V = half(i_plan, i_oth, i_rat, i_val, U, tpci, nbi,
                      num_items_pad)
             return U, V
 
-        return jax.lax.fori_loop(0, n_iters, body, (U, V))
+    else:
+
+        @jax.jit
+        def steps(u_plan, u_oth, u_rat, u_val,
+                  i_plan, i_oth, i_rat, i_val, U, V, n_iters):
+            """ALL iterations inside one compiled program (lax.fori_loop
+            with a dynamic trip count, so num_iterations stays out of the
+            compile key).  One host dispatch per train instead of one per
+            iteration — on a remote-tunneled device each dispatch costs a
+            ~100 ms round trip, which at 20 iterations was a measurable
+            slice of the whole train."""
+
+            def body(_, uv):
+                U, V = uv
+                U = half(u_plan, u_oth, u_rat, u_val, V, tpcu, nbu,
+                         num_users_pad)
+                V = half(i_plan, i_oth, i_rat, i_val, U, tpci, nbi,
+                         num_items_pad)
+                return U, V
+
+            return jax.lax.fori_loop(0, n_iters, body, (U, V))
 
     _STEP_CACHE[key] = steps
     return steps
@@ -350,13 +369,34 @@ def _data_fingerprint(*arrays) -> str:
     return h.hexdigest()
 
 
+def _is_oom_error(e: Exception) -> bool:
+    """Resource exhaustion as surfaced by jax across paths: direct
+    RESOURCE_EXHAUSTED XlaRuntimeErrors, stringified 'Ran out of memory in
+    memory space hbm', and the axon remote-compile tunnel's opaque
+    'tpu_compile_helper subprocess exit code 1' INTERNAL wrapper (the real
+    OOM text only reaches the terminal's stderr, not the exception — a
+    compile-helper death is a compile-side failure either way, and the
+    fallback ladder re-raises at the last rung if it wasn't memory)."""
+    s = str(e)
+    return (
+        "RESOURCE_EXHAUSTED" in s
+        or "Ran out of memory" in s
+        or "out of memory" in s.lower()
+        or ("remote_compile" in s and "tpu_compile_helper" in s)
+    )
+
+
 def _train_pallas(user_idx, item_idx, rating, num_users, num_items,
                   p: ALSParams, dtype) -> "ALSState":
-    """Single-device TPU train via the scatter-free pallas accumulator."""
-    from predictionio_tpu.ops import als_pallas
+    """Single-device TPU train via the scatter-free pallas accumulator.
 
-    num_users_pad = max((num_users + 127) // 128 * 128, 128)
-    num_items_pad = max((num_items + 127) // 128 * 128, 128)
+    Degrades instead of dying on HBM exhaustion: the dispatch ladder is
+    ``fused -> chunked -> chunked per-iteration`` (each step cuts peak HBM
+    — the chunk scan drops the whole-stream packed transients; per-
+    iteration dispatch drops the fori_loop's loop-carried remat copies).
+    A shared co-tenanted chip can lose capacity between runs, so one OOM
+    must cost a retry, not the train."""
+    from predictionio_tpu.ops import als_pallas
 
     # mode select: the fused single-grid kernel needs the packed stream
     # ([P, packed_width] f32) resident per half-step; fall back to the
@@ -364,8 +404,50 @@ def _train_pallas(user_idx, item_idx, rating, num_users, num_items,
     mode = p.pallas_mode
     if mode == "auto":
         est_rows = int(len(user_idx) * 1.06) + als_pallas.T  # ~pad factor
-        packed_bytes = est_rows * als_pallas.packed_width(p.rank) * 4
-        mode = "fused" if packed_bytes <= 4 << 30 else "chunked"
+        # The fused path's device-side pack (gather + concat) materializes
+        # several [P, <128] f32 transients; XLA lays those out T(8,128),
+        # padding the minor dim to 128 lanes REGARDLESS of the logical
+        # width — at ML-20M that turned a 1.3G logical stream into 57.65G
+        # of HLO temps and a compile-time HBM OOM (BENCH_r04).  Budget the
+        # PADDED bytes (~6 live transients at 128 lanes) and leave the
+        # rest of HBM for factors + accumulator + XLA slack.
+        padded_transient_bytes = est_rows * 128 * 4 * 6
+        mode = "fused" if padded_transient_bytes <= 4 << 30 else "chunked"
+
+    ladder = [(mode, False)]
+    if mode == "fused":
+        ladder.append(("chunked", False))
+    ladder.append(("chunked", True))
+    for i, (m, per_iter) in enumerate(ladder):
+        try:
+            return _train_pallas_mode(
+                user_idx, item_idx, rating, num_users, num_items, p, dtype,
+                m, per_iter
+            )
+        except Exception as e:  # noqa: BLE001 — filtered to OOM below
+            if not _is_oom_error(e) or i == len(ladder) - 1:
+                raise
+            import warnings
+
+            nxt = ladder[i + 1]
+            warnings.warn(
+                f"ALS pallas {m}{' per-iter' if per_iter else ''} path ran "
+                f"out of HBM ({type(e).__name__}); retrying as "
+                f"{nxt[0]}{' per-iter' if nxt[1] else ''}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            _STAGE_CACHE.clear()  # drop this mode's device streams first
+    raise AssertionError("unreachable")
+
+
+def _train_pallas_mode(user_idx, item_idx, rating, num_users, num_items,
+                       p: ALSParams, dtype, mode: str,
+                       per_iter: bool) -> "ALSState":
+    from predictionio_tpu.ops import als_pallas
+
+    num_users_pad = max((num_users + 127) // 128 * 128, 128)
+    num_items_pad = max((num_items + 127) // 128 * 128, 128)
 
     def stage(seg, oth, num_seg_pad):
         base_plan = als_pallas.build_plan(
@@ -442,17 +524,23 @@ def _train_pallas(user_idx, item_idx, rating, num_users, num_items,
         chunks_item=chunks_i,
         precision=p.pallas_precision,
         mode=mode,
+        per_iter=per_iter,
     )
 
     U, V = _init_factors(p, num_users_pad, num_items_pad, num_users,
                          num_items, dtype)
     steps = _make_pallas_step(
         (tiles_u, up.n_blocks, tiles_i, ip.n_blocks),
-        p, num_users_pad, num_items_pad, fused,
+        p, num_users_pad, num_items_pad, fused, single_step=per_iter,
     )
-    U, V = steps(u_plan, u_oth, u_rat, u_val,
-                 i_plan, i_oth, i_rat, i_val, U, V,
-                 jnp.int32(p.num_iterations))
+    if per_iter:
+        for _ in range(p.num_iterations):
+            U, V = steps(u_plan, u_oth, u_rat, u_val,
+                         i_plan, i_oth, i_rat, i_val, U, V, jnp.int32(1))
+    else:
+        U, V = steps(u_plan, u_oth, u_rat, u_val,
+                     i_plan, i_oth, i_rat, i_val, U, V,
+                     jnp.int32(p.num_iterations))
     jax.block_until_ready((U, V))
     return ALSState(user_factors=U[:num_users], item_factors=V[:num_items])
 

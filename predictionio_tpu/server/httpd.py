@@ -109,11 +109,25 @@ class HTTPApp:
 
         return deco
 
+    def _key_ok(self, req: Request) -> bool:
+        """Constant-time key check.  Preferred transport is an
+        ``Authorization: Bearer <key>`` header (doesn't land in proxy /
+        access logs); the ``?accessKey=`` query parameter is kept for
+        dashboard-link parity (Dashboard.scala:47)."""
+        import hmac
+
+        auth = req.headers.get("Authorization", "") if req.headers else ""
+        if auth.startswith("Bearer "):
+            presented = auth[len("Bearer "):]
+        else:
+            presented = req.query.get("accessKey", "")
+        # bytes, not str: compare_digest raises TypeError on non-ASCII str
+        return hmac.compare_digest(
+            presented.encode("utf-8"), self.access_key.encode("utf-8")
+        )
+
     def handle(self, req: Request) -> Response:
-        if (
-            self.access_key is not None
-            and req.query.get("accessKey") != self.access_key
-        ):
+        if self.access_key is not None and not self._key_ok(req):
             return error_response(401, "Invalid accessKey.")
         path_matched = False
         for method, pattern, fn in self._routes:

@@ -31,6 +31,8 @@ import dataclasses
 import json
 from pathlib import Path
 
+import numpy as np
+
 from predictionio_tpu.data.event import Event, EventValidationError
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.config import StorageConfig, StorageRuntime
@@ -359,12 +361,28 @@ def create_storage_app(
         app_id, chan, flt = int(req.params["app"]), _chan(req), _req_filter(req)
         pe = rt.p_events()
         csv = req.query.get("shards")
-        if csv is not None and hasattr(pe, "iter_shards"):
+        if csv is not None:
             want = [int(x) for x in csv.split(",") if x != ""]
-            frames = [
-                f for _, f in pe.iter_shards(app_id, chan, flt, shards=want)
-            ]
-            frame = _concat_frames(frames)
+            if hasattr(pe, "iter_shards"):
+                frames = [
+                    f for _, f in pe.iter_shards(app_id, chan, flt, shards=want)
+                ]
+                frame = _concat_frames(frames)
+            else:
+                # The base PEvents contract doesn't require iter_shards.
+                # Clients (RemotePEvents' singleton fast path) trust that a
+                # shard-restricted response IS the requested shards, so a
+                # full-scan answer here would hand every worker the whole
+                # event log — silent row duplication in multi-process
+                # training.  Re-split server-side with the shared hash.
+                from predictionio_tpu.data.storage.base import frame_shard_of
+
+                frame = pe.find(app_id, chan, flt)
+                shard_of = frame_shard_of(
+                    frame.entity_type, frame.entity_id,
+                    pe.n_shards(app_id, chan),
+                )
+                frame = frame.take(np.isin(shard_of, want))
         else:
             frame = pe.find(app_id, chan, flt)
         return Response(

@@ -345,6 +345,20 @@ def do_storageserver(args) -> int:
     at it."""
     from predictionio_tpu.server.storage_server import StorageServer
 
+    if (
+        args.ip not in ("127.0.0.1", "localhost", "::1")
+        and not args.access_key
+        and not os.environ.get("PIO_STORAGE_SERVER_ALLOW_OPEN")
+    ):
+        print(
+            f"storageserver: refusing to bind {args.ip} without --access-key "
+            "(the daemon exposes raw model-blob writes; a remote pickle "
+            "write is code execution on the next train/deploy host). Pass "
+            "--access-key, bind 127.0.0.1, or set "
+            "PIO_STORAGE_SERVER_ALLOW_OPEN=1 to override.",
+            file=sys.stderr,
+        )
+        return 1
     server = StorageServer(
         root=args.root,
         host=args.ip,
@@ -668,7 +682,11 @@ def build_parser() -> argparse.ArgumentParser:
     db.set_defaults(fn=do_dashboard)
 
     ss = sub.add_parser("storageserver")
-    ss.add_argument("--ip", default="0.0.0.0")
+    # Loopback by default: the daemon serves unauthenticated read/write of
+    # events, metadata, and pickled model blobs, so an open bind without an
+    # access key is remote code execution on the next host that loads a
+    # model.  Non-loopback binds demand a key (or an explicit override).
+    ss.add_argument("--ip", default="127.0.0.1")
     ss.add_argument("--port", type=int, default=7072)
     ss.add_argument(
         "--root",

@@ -82,3 +82,60 @@ class TestMeshConfig:
             make_mesh(MeshConfig({"data": -1, "model": -1}))
         with pytest.raises(ValueError):
             make_mesh(MeshConfig({"data": 16}))
+
+
+class TestOOMFallbackLadder:
+    """HBM exhaustion degrades fused -> chunked -> per-iteration instead of
+    killing the train (the BENCH_r04 failure mode)."""
+
+    def test_is_oom_error_matches_known_shapes(self):
+        from predictionio_tpu.ops.als import _is_oom_error
+
+        assert _is_oom_error(RuntimeError("RESOURCE_EXHAUSTED: foo"))
+        assert _is_oom_error(
+            RuntimeError("Ran out of memory in memory space hbm.")
+        )
+        # the axon remote-compile tunnel's opaque wrapper
+        assert _is_oom_error(RuntimeError(
+            "INTERNAL: http://127.0.0.1:8113/remote_compile: HTTP 500: "
+            "tpu_compile_helper subprocess exit code 1"
+        ))
+        assert not _is_oom_error(ValueError("shape mismatch"))
+
+    def test_ladder_falls_back_on_oom(self, monkeypatch):
+        from predictionio_tpu.ops import als as als_mod
+
+        attempts = []
+
+        def fake_mode(user_idx, item_idx, rating, nu, ni, p, dtype, mode,
+                      per_iter):
+            attempts.append((mode, per_iter))
+            if len(attempts) < 3:
+                raise RuntimeError("Ran out of memory in memory space hbm.")
+            return "sentinel-state"
+
+        monkeypatch.setattr(als_mod, "_train_pallas_mode", fake_mode)
+        p = als_mod.ALSParams(rank=4, pallas_mode="fused")
+        with pytest.warns(RuntimeWarning):
+            out = als_mod._train_pallas(
+                np.zeros(4, np.int64), np.zeros(4, np.int64),
+                np.ones(4, np.float32), 4, 4, p, np.float32,
+            )
+        assert out == "sentinel-state"
+        assert attempts == [
+            ("fused", False), ("chunked", False), ("chunked", True)
+        ]
+
+    def test_ladder_reraises_non_oom(self, monkeypatch):
+        from predictionio_tpu.ops import als as als_mod
+
+        def fake_mode(*a, **k):
+            raise ValueError("genuine bug")
+
+        monkeypatch.setattr(als_mod, "_train_pallas_mode", fake_mode)
+        p = als_mod.ALSParams(rank=4, pallas_mode="chunked")
+        with pytest.raises(ValueError, match="genuine bug"):
+            als_mod._train_pallas(
+                np.zeros(4, np.int64), np.zeros(4, np.int64),
+                np.ones(4, np.float32), 4, 4, p, np.float32,
+            )

@@ -342,14 +342,21 @@ class TestLazyProperties:
         np.testing.assert_allclose(got[[0, 2]], [4.5, 2.0])
         assert np.isnan(got[[1, 3, 4]]).all()  # nested key does NOT count
 
-    def test_property_column_non_numeric_and_bool_excluded(self):
+    def test_property_column_coercion_contract(self):
+        """Numeric strings and bools coerce like the row-wise engine loops'
+        float(props[name]); non-numeric strings don't count."""
         import numpy as np
 
-        lazy = self._frame(['{"v": "high"}', '{"v": true}', '{"v": 3}'])
-        eager = self._frame([{"v": "high"}, {"v": True}, {"v": 3}])
-        np.testing.assert_array_equal(
-            lazy.property_column("v"), eager.property_column("v")
+        lazy = self._frame(
+            ['{"v": "high"}', '{"v": true}', '{"v": 3}', '{"v": "4.5"}']
         )
+        eager = self._frame(
+            [{"v": "high"}, {"v": True}, {"v": 3}, {"v": "4.5"}]
+        )
+        got_l, got_e = lazy.property_column("v"), eager.property_column("v")
+        np.testing.assert_array_equal(got_l, got_e)
+        assert np.isnan(got_e[0])
+        np.testing.assert_allclose(got_e[1:], [1.0, 3.0, 4.5])
 
     def test_to_events_decodes_lazy_rows(self):
         lazy = self._frame(['{"rating": 4.5}', ""])
