@@ -853,17 +853,25 @@ def main() -> None:
             width = pi["width"]
             passes = {"hilo": 2, "bf16": 1, "highest": 6}[pi["precision"]]
             row_b = width * 4
+            k_pad = (pi["rank"] + 7) // 8 * 8  # sublane round-up
             gb = 0.0
             fl = 0.0
             for side in ("user", "item"):
                 rows = pi[f"rows_{side}"]
-                # gather factors + write flat rows + kernel reads flat rows
-                gb += rows * (512 + 2 * row_b) / 1e9
-                # per-chunk accumulator read-modify-write on visited blocks
-                gb += (
-                    pi[f"chunks_{side}"] * pi[f"blocks_{side}"] * 128
-                    * row_b * 3
-                ) / 1e9
+                if pi.get("mode") == "fused":
+                    # transposed gather write+read of cv_t [nt, k_pad, T]
+                    # + wrv [nt, 8, T] read + seg3 + one output write per
+                    # block (VMEM-carried: no accumulator re-reads)
+                    gb += rows * (2 * k_pad * 4 + 8 * 4 + 4) / 1e9
+                    gb += pi[f"blocks_{side}"] * 128 * row_b / 1e9
+                else:
+                    # gather factors + write flat rows + kernel read
+                    gb += rows * (512 + 2 * row_b) / 1e9
+                    # per-chunk accumulator read-modify-write
+                    gb += (
+                        pi[f"chunks_{side}"] * pi[f"blocks_{side}"] * 128
+                        * row_b * 3
+                    ) / 1e9
                 fl += 2.0 * rows * 128 * width * passes / 1e12
             it_s = C.train_s / C.params.num_iterations
             metrics["roofline_gb_per_iter"] = round(gb, 2)

@@ -239,6 +239,14 @@ class StorageRuntime:
                 region=props.get("REGION"),
                 endpoint=props.get("ENDPOINT"),
             )
+        if typ == "hdfs":
+            from predictionio_tpu.data.storage.fsspec_models import (
+                FsspecModels,
+            )
+
+            return FsspecModels(
+                props.get("PATH", str(self.config.home / "models"))
+            )
         if typ in ("sqlite", "postgres", "jdbc"):
             return SQLiteModels(self._sql_client(name, props))
         raise StorageError(f"unsupported MODELDATA source type {typ!r}")

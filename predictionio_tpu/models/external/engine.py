@@ -237,6 +237,8 @@ def register_external_model(
         engine_factory="external",
         **params.to_json_fields(),
     )
-    storage.engine_instances().insert(instance)
+    # blob first, instance record last: a failed pickle/save must not
+    # leave a COMPLETED-but-blobless record for deploy to trip over
     save_models(storage.models(), instance.id, [model])
+    storage.engine_instances().insert(instance)
     return instance

@@ -283,20 +283,7 @@ def _make_pallas_step(
     (tpcu, nbu, tpci, nbi) = key_shapes
     k = p.rank
 
-    def half(plan_args, oth, rat, val, other_factors, tpc, n_blocks,
-             num_seg_pad):
-        if fused:
-            acc = als_pallas.segment_stats_fused(
-                plan_args, oth, rat, val, other_factors,
-                p.implicit_prefs, p.alpha, tpc, n_blocks,
-                precision=p.pallas_precision,
-            )[:num_seg_pad]
-        else:
-            acc = als_pallas.segment_stats_pallas(
-                plan_args, oth, rat, val, other_factors,
-                p.implicit_prefs, p.alpha, tpc, n_blocks,
-                precision=p.pallas_precision,
-            )[:num_seg_pad]
+    def solve(acc, other_factors):
         A = acc[:, : k * k].reshape(-1, k, k)
         b = acc[:, k * k : k * k + k]
         counts = acc[:, k * k + k]
@@ -305,15 +292,41 @@ def _make_pallas_step(
         )
         return _solve_factors(A, b, counts, p.reg, p.scale_reg_with_count, gram)
 
+    def half(plan_args, oth, wrv_or_rat, val, other_factors, tpc, n_blocks,
+             num_seg_pad):
+        if fused:
+            # wrv_or_rat is the precomputed [nt, 3, T] weight stack; val
+            # is unused (folded into wrv once per dispatch)
+            acc = als_pallas.segment_stats_fused(
+                plan_args, oth, wrv_or_rat, other_factors, tpc, n_blocks,
+                precision=p.pallas_precision,
+            )[:num_seg_pad]
+        else:
+            acc = als_pallas.segment_stats_pallas(
+                plan_args, oth, wrv_or_rat, val, other_factors,
+                p.implicit_prefs, p.alpha, tpc, n_blocks,
+                precision=p.pallas_precision,
+            )[:num_seg_pad]
+        return solve(acc, other_factors)
+
+    def prep(rat, val):
+        """Per-dispatch (NOT per-iteration) weight precompute for the
+        fused path; the chunked kernel recomputes weights per chunk
+        in-body instead."""
+        if not fused:
+            return rat
+        return als_pallas.make_wrv(rat, val, p.implicit_prefs, p.alpha)
+
     if single_step:
 
         @jax.jit
         def steps(u_plan, u_oth, u_rat, u_val,
                   i_plan, i_oth, i_rat, i_val, U, V, n_iters):
             del n_iters  # one iteration per dispatch, caller loops
-            U = half(u_plan, u_oth, u_rat, u_val, V, tpcu, nbu,
+            u_w, i_w = prep(u_rat, u_val), prep(i_rat, i_val)
+            U = half(u_plan, u_oth, u_w, u_val, V, tpcu, nbu,
                      num_users_pad)
-            V = half(i_plan, i_oth, i_rat, i_val, U, tpci, nbi,
+            V = half(i_plan, i_oth, i_w, i_val, U, tpci, nbi,
                      num_items_pad)
             return U, V
 
@@ -328,12 +341,13 @@ def _make_pallas_step(
             iteration — on a remote-tunneled device each dispatch costs a
             ~100 ms round trip, which at 20 iterations was a measurable
             slice of the whole train."""
+            u_w, i_w = prep(u_rat, u_val), prep(i_rat, i_val)
 
             def body(_, uv):
                 U, V = uv
-                U = half(u_plan, u_oth, u_rat, u_val, V, tpcu, nbu,
+                U = half(u_plan, u_oth, u_w, u_val, V, tpcu, nbu,
                          num_users_pad)
-                V = half(i_plan, i_oth, i_rat, i_val, U, tpci, nbi,
+                V = half(i_plan, i_oth, i_w, i_val, U, tpci, nbi,
                          num_items_pad)
                 return U, V
 
@@ -398,21 +412,30 @@ def _train_pallas(user_idx, item_idx, rating, num_users, num_items,
     must cost a retry, not the train."""
     from predictionio_tpu.ops import als_pallas
 
-    # mode select: the fused single-grid kernel needs the packed stream
-    # ([P, packed_width] f32) resident per half-step; fall back to the
-    # chunk-scan when that transient would crowd HBM
+    # mode select: the fused single-grid kernel streams the transposed
+    # gather output ([nt, k, T] f32) per half-step; fall back to the
+    # chunk-scan when that transient would crowd HBM or the update rows
+    # would not fit VMEM (rank > 22)
     mode = p.pallas_mode
     if mode == "auto":
         est_rows = int(len(user_idx) * 1.06) + als_pallas.T  # ~pad factor
-        # The fused path's device-side pack (gather + concat) materializes
-        # several [P, <128] f32 transients; XLA lays those out T(8,128),
-        # padding the minor dim to 128 lanes REGARDLESS of the logical
-        # width — at ML-20M that turned a 1.3G logical stream into 57.65G
-        # of HLO temps and a compile-time HBM OOM (BENCH_r04).  Budget the
-        # PADDED bytes (~6 live transients at 128 lanes) and leave the
-        # rest of HBM for factors + accumulator + XLA slack.
-        padded_transient_bytes = est_rows * 128 * 4 * 6
-        mode = "fused" if padded_transient_bytes <= 4 << 30 else "chunked"
+        # Fused-path HBM budget: the transposed gather output cv_t
+        # [k, nt, T] (k padded to the next sublane multiple of 8) is the
+        # big per-half-step transient, the staged wrv [3->8, nt, T] stacks
+        # live for the whole train, and XLA may keep ~2 transients alive
+        # across the double-buffered halves.  (The round-4 fused path was
+        # gated on UNPADDED bytes while materializing [P, <128] arrays
+        # that T(8,128)-pad to 128 lanes — 57G of HLO temps at ML-20M,
+        # BENCH_r04.  The transposed orientation keeps minor dims at 1024
+        # so padding cannot exceed the sublane round-up.)
+        k_pad = (p.rank + 7) // 8 * 8
+        fused_bytes = est_rows * 4 * (2 * k_pad + 2 * 8)
+        fits_vmem = als_pallas.row_width(p.rank) <= als_pallas.FUSED_MAX_WIDTH
+        mode = (
+            "fused"
+            if fits_vmem and fused_bytes <= 4 << 30
+            else "chunked"
+        )
 
     ladder = [(mode, False)]
     if mode == "fused":
@@ -462,7 +485,9 @@ def _train_pallas_mode(user_idx, item_idx, rating, num_users, num_items,
                 jnp.asarray(plan.first),
                 jnp.asarray(plan.seg3),
             )
-            shape2 = (rows,)
+            # [nt, T], minor dim 1024: layout-clean on device (no T(8,128)
+            # minor-dim padding possible)
+            shape2 = (plan.n_tiles, als_pallas.T)
         else:
             plan = als_pallas.chunk_plan(base_plan)
             rows = plan.n_chunks * plan.tiles_per_chunk * als_pallas.T

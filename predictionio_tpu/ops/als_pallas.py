@@ -192,58 +192,91 @@ def make_segment_accum(
     )
 
 
-def packed_width(rank: int) -> int:
-    """Lane width of the fused kernel's packed input row:
-    [v_0..v_{k-1} | w | rhs | valid], padded to a 16-lane multiple."""
-    return (rank + 3 + 15) // 16 * 16
+#: fused-path VMEM budget: A/B/updT (+bf16 splits) are [width, T] tiles, so
+#: width*T*4*~5 bytes must fit VMEM alongside double-buffered inputs —
+#: width 512 (rank <= 22) keeps the working set under ~10 MB
+FUSED_MAX_WIDTH = 512
 
 
 def _make_fused_kernel(k: int, width: int, precision: str):
-    """Kernel that BUILDS the flat update rows in VMEM from a compact
-    packed input instead of streaming pre-built [T, width] rows from HBM:
-    the HBM traffic per tile drops from T*width*4 bytes to T*packed*4
-    (~8x at rank 10), and with the grid spanning the WHOLE stream the
-    revisited output blocks accumulate inside pallas — no per-chunk
-    accumulator round trips through XLA at all."""
+    """Whole-stream fused kernel in TRANSPOSED orientation.
 
-    def kernel(block_map_ref, first_ref, seg_ref, packed_ref, out_ref):
+    Every HBM-resident per-row array is layout-clean (minor dim T=1024 or
+    128): the opposite factors arrive pre-gathered as ``cv_t [k, nt, T]``
+    and the static weights as ``wrv [3, nt, T]`` — there is NO tall-narrow
+    ``[P, <128]`` array anywhere, which is what turned the round-4 fused
+    path into 57G of T(8,128)-padded HLO temps (BENCH_r04).
+
+    The flat update rows are built IN VMEM as their transpose
+    ``updT [width, T]`` without any sublane concatenation: two static
+    one-hot selection matrices (pa picks component a = r//k, pb picks
+    b = r%k, both materialized from iota compares) turn the outer-product
+    block, the rhs block, and the count row into
+
+        updT = (pa@cv) * ((pb@cv) * w + sel_rhs * rhs) + sel_val * val
+
+    — rows r < k*k get cv_a*cv_b*w, rows k*k..k*k+k get cv_c*rhs (pb@cv
+    is zero there), row k*k+k gets val, the rest 0.  The selection matmuls
+    run at Precision.HIGHEST (exact for f32, ~2.6 MFLOP — noise).
+
+    With the stream sorted by destination block and ``out_specs`` indexed
+    by ``block_map``, each output block stays VMEM-resident across all its
+    tiles and is written to HBM exactly once — the chunk scan's per-chunk
+    accumulator read-modify-write (71 MB per chunk per half-step at
+    ML-20M) disappears entirely.
+    """
+    kk = k * k
+
+    def kernel(block_map_ref, first_ref, seg_ref, cv_ref, wrv_ref, out_ref):
         i = pl.program_id(0)
-        seg_row = seg_ref[0].reshape(1, T)
-        oh_t = jax.lax.broadcasted_iota(jnp.int32, (S, T), 0) == seg_row
-        packed = packed_ref[:]  # [T, packed_width]
-        cv = packed[:, :k]
-        w = packed[:, k : k + 1]
-        rhs = packed[:, k + 1 : k + 2]
-        val = packed[:, k + 2 : k + 3]
-        # vec(v v^T) via k lane-sliced broadcasts (k static)
-        outer = jnp.concatenate([cv[:, a : a + 1] * cv for a in range(k)], 1)
-        upd = jnp.concatenate(
-            [
-                outer * w,
-                cv * rhs,
-                val,
-                jnp.zeros((T, width - (k * k + k + 1)), packed.dtype),
-            ],
-            axis=1,
+        seg = seg_ref[0]  # [T//128, 128] int32
+        onehot = (
+            seg[:, :, None]
+            == jax.lax.broadcasted_iota(jnp.int32, (T // 128, 128, S), 2)
+        ).astype(jnp.float32).reshape(T, S)
+        cv = cv_ref[0]    # [k, T]
+        wrv = wrv_ref[0]  # [3, T]
+        w, rhs, val = wrv[0:1, :], wrv[1:2, :], wrv[2:3, :]
+        r = jax.lax.broadcasted_iota(jnp.int32, (width, k), 0)
+        c = jax.lax.broadcasted_iota(jnp.int32, (width, k), 1)
+        # select between int32 index maps, not between booleans: Mosaic
+        # cannot truncate an i8 select result to i1
+        a_idx = jnp.where(r < kk, r // k, r - kk)
+        pa = ((a_idx == c) & (r < kk + k))
+        pb = ((r % k) == c) & (r < kk)
+        dn_sel = (((1,), (0,)), ((), ()))
+        hp = jax.lax.Precision.HIGHEST
+        A = jax.lax.dot_general(
+            pa.astype(jnp.float32), cv, dimension_numbers=dn_sel,
+            precision=hp, preferred_element_type=jnp.float32,
         )
-        dn = (((1,), (0,)), ((), ()))
+        B = jax.lax.dot_general(
+            pb.astype(jnp.float32), cv, dimension_numbers=dn_sel,
+            precision=hp, preferred_element_type=jnp.float32,
+        )
+        r1 = jax.lax.broadcasted_iota(jnp.int32, (width, 1), 0)
+        sel_rhs = ((r1 >= kk) & (r1 < kk + k)).astype(jnp.float32)
+        sel_val = (r1 == kk + k).astype(jnp.float32)
+        updT = A * (B * w + sel_rhs * rhs) + sel_val * val
+
+        dn = (((1,), (0,)), ((), ()))  # contract T: [width,T] @ [T,S]
         if precision == "highest":
             contrib = jax.lax.dot_general(
-                oh_t.astype(jnp.float32), upd, dimension_numbers=dn,
+                updT, onehot, dimension_numbers=dn,
                 preferred_element_type=jnp.float32,
                 precision=jax.lax.Precision.HIGHEST,
             )
         else:
-            oh16 = oh_t.astype(jnp.bfloat16)
-            hi = upd.astype(jnp.bfloat16)
+            oh16 = onehot.astype(jnp.bfloat16)
+            hi = updT.astype(jnp.bfloat16)
             contrib = jax.lax.dot_general(
-                oh16, hi, dimension_numbers=dn,
+                hi, oh16, dimension_numbers=dn,
                 preferred_element_type=jnp.float32,
             )
             if precision == "hilo":
-                lo = (upd - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+                lo = (updT - hi.astype(jnp.float32)).astype(jnp.bfloat16)
                 contrib = contrib + jax.lax.dot_general(
-                    oh16, lo, dimension_numbers=dn,
+                    lo, oh16, dimension_numbers=dn,
                     preferred_element_type=jnp.float32,
                 )
 
@@ -265,37 +298,59 @@ def make_fused_accum(
     precision: str = "hilo",
     interpret: bool = False,
 ):
-    """pallas_call over the WHOLE stream: (block_map[nt], first[nt], seg3,
-    packed[P, packed_width]) -> accumulator [n_blocks*S, row_width]."""
+    """pallas_call over the WHOLE stream: (block_map[nt], first[nt],
+    seg3[nt, T//128, 128], cv_t[nt, k, T], wrv[nt, 3, T]) -> TRANSPOSED
+    accumulator [n_blocks * width, S] (blocks of [width, S]).
+
+    The per-tile operands are [nt, small, T]: Mosaic wants the last two
+    block dims divisible by (8, 128) or equal to the array dims, so the
+    tile axis leads and the small axis (k or 3) spans its whole dimension;
+    HBM sublane padding rounds k up to 8s (1.6x at rank 10 — bounded,
+    unlike the minor-dim 128 round-up a [P, k] layout suffers)."""
     if precision not in ("highest", "hilo", "bf16"):
         raise ValueError(f"unknown precision {precision!r}")
     width = row_width(rank)
-    kl = packed_width(rank)
+    if width > FUSED_MAX_WIDTH:
+        raise ValueError(
+            f"fused path supports row_width <= {FUSED_MAX_WIDTH} "
+            f"(rank <= 22); got width {width} — use the chunked path"
+        )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(n_tiles,),
         in_specs=[
             pl.BlockSpec((1, T // 128, 128), lambda i, bm, fr: (i, 0, 0)),
-            pl.BlockSpec((T, kl), lambda i, bm, fr: (i, 0)),
+            pl.BlockSpec((1, rank, T), lambda i, bm, fr: (i, 0, 0)),
+            pl.BlockSpec((1, 3, T), lambda i, bm, fr: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((S, width), lambda i, bm, fr: (bm[i], 0)),
+        out_specs=pl.BlockSpec((width, S), lambda i, bm, fr: (bm[i], 0)),
     )
     return pl.pallas_call(
         _make_fused_kernel(rank, width, precision),
-        out_shape=jax.ShapeDtypeStruct((n_blocks * S, width), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((n_blocks * width, S), jnp.float32),
         grid_spec=grid_spec,
         interpret=interpret,
     )
 
 
+def make_wrv(rating2d, valid2d, implicit_prefs: bool, alpha: float):
+    """Static per-row weights for the fused kernel, layout-clean
+    [nt, 3, T]: A-weight | rhs | valid.  Depends on data + train
+    hyperparams only — computed once per train dispatch, NOT per
+    iteration."""
+    from predictionio_tpu.ops.als import confidence_weights
+
+    w, rhs = confidence_weights(
+        rating2d, valid2d, implicit_prefs, alpha, jnp.float32
+    )
+    return jnp.stack([w, rhs, valid2d.astype(jnp.float32)], axis=1)
+
+
 def segment_stats_fused(
     plan_args: tuple,
-    other_idx_p,    # [P] padded/permuted opposite-entity index (flat)
-    rating_p,       # [P] padded rating (0 at padding)
-    valid_p,        # [P] padded validity (0 at padding)
+    other_idx2d,    # [nt, T] int32 padded/permuted opposite-entity index
+    wrv,            # [nt, 3, T] f32 from make_wrv
     other_factors,  # [num_other_pad, k] replicated
-    implicit_prefs: bool,
-    alpha: float,
     n_tiles: int,
     n_blocks: int,
     precision: str = "hilo",
@@ -303,33 +358,24 @@ def segment_stats_fused(
 ):
     """Single-grid fused accumulation over the whole stream.  Same output
     contract as segment_stats_pallas ([n_blocks*S, row_width] with columns
-    [vec(A) | b | count]) but the flat update rows never exist in HBM:
-    the kernel builds them in VMEM from the packed [P, packed_width]
-    stream (factors | A-weight | rhs | valid)."""
+    [vec(A) | b | count]); internally everything runs transposed (see
+    _make_fused_kernel) and the per-half-step device work is ONE gather
+    (columns of the transposed factor table, laid out [nt, k, T]) plus
+    the kernel."""
     block_map, first, seg3 = plan_args
     k = other_factors.shape[1]
-    kl = packed_width(k)
-    P = n_tiles * T
-
-    from predictionio_tpu.ops.als import confidence_weights
-
-    cv = other_factors[other_idx_p]
-    w, rhs = confidence_weights(rating_p, valid_p, implicit_prefs, alpha,
-                                cv.dtype)
-    packed = jnp.concatenate(
-        [
-            cv,
-            w[:, None],
-            rhs[:, None],
-            valid_p[:, None].astype(cv.dtype),
-            jnp.zeros((P, kl - (k + 3)), cv.dtype),
-        ],
-        axis=1,
-    )
+    width = row_width(k)
+    # [k, nt, T] gather -> [nt, k, T] tile-major for the BlockSpec
+    cv_t = jnp.take(other_factors.T, other_idx2d, axis=1).transpose(1, 0, 2)
     accum = make_fused_accum(
         n_tiles, n_blocks, k, precision=precision, interpret=interpret
     )
-    return accum(block_map, first, seg3, packed)
+    acc_t = accum(block_map, first, seg3, cv_t, wrv)
+    return (
+        acc_t.reshape(n_blocks, width, S)
+        .transpose(0, 2, 1)
+        .reshape(n_blocks * S, width)
+    )
 
 
 @dataclass(frozen=True)

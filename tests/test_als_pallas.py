@@ -156,12 +156,18 @@ def test_segment_stats_fused_matches_scatter_semantics():
     rat_p[plan.pad_mask] = 0
     val_p[plan.pad_mask] = 0
 
+    nt = plan.n_tiles
     for implicit in (False, True):
+        wrv = ap.make_wrv(
+            jnp.asarray(rat_p.reshape(nt, ap.T)),
+            jnp.asarray(val_p.reshape(nt, ap.T)),
+            implicit, 1.5,
+        )
         acc = ap.segment_stats_fused(
             (jnp.asarray(plan.block_map), jnp.asarray(plan.first),
              jnp.asarray(plan.seg3)),
-            jnp.asarray(oth_p), jnp.asarray(rat_p), jnp.asarray(val_p),
-            jnp.asarray(factors), implicit, 1.5,
+            jnp.asarray(oth_p.reshape(nt, ap.T)), wrv,
+            jnp.asarray(factors),
             plan.n_tiles, plan.n_blocks, interpret=True,
         )
         acc = np.asarray(acc)[:nseg]
@@ -187,8 +193,8 @@ def test_segment_stats_fused_matches_scatter_semantics():
         np.testing.assert_allclose(acc[:, k * k + k], c_ref, rtol=1e-5)
 
 
-def test_packed_width():
-    assert ap.packed_width(10) == 16
-    assert ap.packed_width(13) == 16
-    assert ap.packed_width(14) == 32
-    assert ap.packed_width(32) == 48
+def test_fused_width_cap():
+    assert ap.row_width(10) == 128
+    assert ap.row_width(22) == 512  # largest fused-eligible rank
+    with pytest.raises(ValueError, match="chunked"):
+        ap.make_fused_accum(4, 2, rank=32)
