@@ -50,8 +50,22 @@ class NCFAlgorithmParams:
     positive_threshold: float = 4.0  # ratings >= this are positives
     negatives_per_positive: int = 1  # K sampled negatives per step
     neg_power: float = 0.0  # see ops.ncf.NCFParams.neg_power
-    loss: str = "bpr"  # "bpr" | "softmax" (sampled softmax over 1+K)
+    #: "bpr" | "softmax" | "full_softmax" | "wals" (whole-catalog losses
+    #: need mlp_layers=())
+    loss: str = "bpr"
     item_bias: bool = True  # learned per-item score offset
+    weight_decay: float = 0.0  # AdamW decoupled decay (0 = plain Adam)
+    #: iALS confidence weight (loss="wals" and the "als" pretrainer)
+    alpha: float = 2.0
+    #: "" (random init) or "als": pretrain the GMF tables with implicit
+    #: ALS (rank = embed_dim, exact alternating solves — seconds on the
+    #: pallas path) before SGD fine-tuning.  The NCF paper's §3.4.1
+    #: pretraining recipe with ALS as the GMF pretrainer; requires
+    #: mlp_layers=().  Measured on the ML-20M bench protocol: sampled
+    #: losses plateau at MAP@10 ~0.0225, whole-catalog SGD from scratch
+    #: reaches ~0.029, ALS-init + 1 epoch full_softmax matches/exceeds
+    #: the pure-ALS 0.0307 with better Precision@10.
+    pretrain: str = ""
     seed: int = 3
 
     params_aliases = {
@@ -64,7 +78,17 @@ class NCFAlgorithmParams:
         "negativesPerPositive": "negatives_per_positive",
         "negPower": "neg_power",
         "itemBias": "item_bias",
+        "weightDecay": "weight_decay",
     }
+
+    def __post_init__(self):
+        if self.pretrain not in ("", "als"):
+            raise ValueError(f"unknown pretrain {self.pretrain!r}")
+        if self.pretrain == "als" and self.mlp_layers:
+            raise ValueError(
+                "pretrain='als' initializes the pure-GMF tables: set "
+                "mlpLayers to []"
+            )
 
 
 @partial(jax.jit, static_argnames=("n_items", "k"))
@@ -125,19 +149,22 @@ def _host_score_topk(hp: dict, uidx: int, n_items: int, k: int):
     sub-ms at catalog scale.  The wave path (batch_predict /
     _score_topk_batch) stays on device where batching amortizes the
     dispatch.  Mirrors the ALS template's host-replica solo serving."""
-    d = hp["user_emb"].shape[1] // 2
-    n_full = hp["item_emb"].shape[0]
-    ue = hp["user_emb"][uidx]
-    gmf = ue[None, :d] * hp["item_emb"][:, :d]
-    h = np.concatenate(
-        [np.broadcast_to(ue[d:], (n_full, d)), hp["item_emb"][:, d:]],
-        axis=-1,
-    )
-    for layer in hp["mlp"]:
-        h = np.maximum(h @ layer["w"] + layer["b"], 0.0)
-    score = (np.concatenate([gmf, h], axis=-1) @ hp["out_w"] + hp["out_b"])[
-        :, 0
-    ]
+    if "out_w" not in hp:  # pure GMF (mlp_layers=())
+        score = hp["item_emb"] @ hp["user_emb"][uidx] + hp["out_b"][0]
+    else:
+        d = hp["user_emb"].shape[1] // 2
+        n_full = hp["item_emb"].shape[0]
+        ue = hp["user_emb"][uidx]
+        gmf = ue[None, :d] * hp["item_emb"][:, :d]
+        h = np.concatenate(
+            [np.broadcast_to(ue[d:], (n_full, d)), hp["item_emb"][:, d:]],
+            axis=-1,
+        )
+        for layer in hp["mlp"]:
+            h = np.maximum(h @ layer["w"] + layer["b"], 0.0)
+        score = (
+            np.concatenate([gmf, h], axis=-1) @ hp["out_w"] + hp["out_b"]
+        )[:, 0]
     bias = hp.get("item_bias")
     if bias is not None:
         score = score + bias
@@ -189,6 +216,26 @@ class NCFAlgorithm(Algorithm):
                 f"no positive interactions (rating >= {p.positive_threshold})"
             )
         mesh = ctx.mesh if ctx.mesh.devices.size > 1 else None
+        initial = None
+        if p.pretrain == "als":
+            from predictionio_tpu.ops.als import ALSParams, train_als
+
+            als = train_als(
+                pd.user_idx[positives],
+                pd.item_idx[positives],
+                np.ones(int(positives.sum()), np.float32),
+                len(pd.user_vocab),
+                len(pd.item_vocab),
+                params=ALSParams(
+                    rank=p.embed_dim, num_iterations=20, reg=0.01,
+                    seed=p.seed, implicit_prefs=True, alpha=p.alpha,
+                ),
+                mesh=mesh,
+            )
+            initial = {
+                "user_emb": np.asarray(als.user_factors),
+                "item_emb": np.asarray(als.item_factors),
+            }
         state = train_ncf(
             pd.user_idx[positives],
             pd.item_idx[positives],
@@ -204,9 +251,12 @@ class NCFAlgorithm(Algorithm):
                 neg_power=p.neg_power,
                 loss=p.loss,
                 item_bias=p.item_bias,
+                weight_decay=p.weight_decay,
+                alpha=p.alpha,
                 seed=p.seed,
             ),
             mesh=mesh,
+            initial_params=initial,
         )
         return NCFModel(
             state=state, user_vocab=pd.user_vocab, item_vocab=pd.item_vocab

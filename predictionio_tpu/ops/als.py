@@ -520,10 +520,15 @@ def _train_pallas_mode(user_idx, item_idx, rating, num_users, num_items,
         # evict BEFORE staging: holding the old dataset's device streams
         # while uploading the new ones would transiently double HBM use
         _STAGE_CACHE.clear()
-        staged = (
-            stage(user_idx, item_idx, num_users_pad),
-            stage(item_idx, user_idx, num_items_pad),
-        )
+        # the two scatter directions stage concurrently: the work is
+        # numpy radix sorts + permutes (GIL-released), so two threads
+        # nearly halve the cold-train host staging wall time
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(2) as pool:
+            fu = pool.submit(stage, user_idx, item_idx, num_users_pad)
+            fi = pool.submit(stage, item_idx, user_idx, num_items_pad)
+            staged = (fu.result(), fi.result())
         _STAGE_CACHE[cache_key] = staged
     (up, u_plan, u_oth, u_rat, u_val), (ip, i_plan, i_oth, i_rat, i_val) = (
         staged

@@ -73,7 +73,9 @@ def build_plan(seg: np.ndarray, num_seg_pad: int) -> SegmentPlan:
             f"segment ids must be in [0, {num_seg_pad}); got "
             f"[{int(seg.min())}, {int(seg.max())}]"
         )
-    order = np.argsort(seg, kind="stable")
+    # int32 keys: numpy's stable sort is a radix sort for ints, so half
+    # the key bytes is measurably fewer passes at 20M rows
+    order = np.argsort(seg.astype(np.int32), kind="stable")
     seg_sorted = seg[order]
     n_blocks = num_seg_pad // S
     blk = seg_sorted // S

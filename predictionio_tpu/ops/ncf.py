@@ -46,14 +46,36 @@ class NCFParams:
     #: word2vec/BPR standard) — harder negatives, much better top-k ranking
     #: on Zipf-shaped catalogs
     neg_power: float = 0.0
-    #: ranking loss: "bpr" (pairwise log-sigmoid) or "softmax" (sampled
-    #: softmax cross-entropy over 1+K candidates — usually stronger top-k)
+    #: ranking loss: "bpr" (pairwise log-sigmoid), "softmax" (sampled
+    #: softmax cross-entropy over 1+K candidates), "full_softmax" (exact
+    #: cross-entropy over the WHOLE catalog per positive), or "wals"
+    #: (whole-catalog weighted least squares — the implicit-ALS objective
+    #: trained by SGD; see :func:`wals_loss`).  The whole-catalog losses
+    #: compute logits as one [b, d] @ [d, n_items] matmul and therefore
+    #: require the pure-GMF architecture ``mlp_layers=()``.
     loss: str = "bpr"
     #: learned per-item score offset.  Catalogs with popularity-driven
     #: feedback are mostly explained by a bias term; giving the model one
     #: explicitly frees the embeddings for the interaction structure.
     item_bias: bool = True
+    #: decoupled (AdamW) weight decay.  0 keeps plain Adam.  The
+    #: full_softmax objective needs this: it is expressive enough to
+    #: overfit a 20M-interaction catalog within a few epochs (MAP@10
+    #: peaked at 2 epochs then fell by 30% unregularized), and decay is
+    #: the SGD analog of the L2 term implicit-ALS bakes into its normal
+    #: equations (reg=0.01 there).
+    weight_decay: float = 0.0
+    #: confidence weight on observed interactions for loss="wals" (the
+    #: iALS alpha; the recommendation templates' bench config uses 2.0)
+    alpha: float = 2.0
     seed: int = 3
+
+    def __post_init__(self):
+        allowed = ("bpr", "softmax", "full_softmax", "wals")
+        if self.loss not in allowed:
+            raise ValueError(
+                f"unknown loss {self.loss!r}; expected one of {allowed}"
+            )
 
 
 def init_ncf(rng: jax.Array, n_users: int, n_items: int, p: NCFParams) -> dict:
@@ -69,6 +91,21 @@ def init_ncf(rng: jax.Array, n_users: int, n_items: int, p: NCFParams) -> dict:
     keys = jax.random.split(rng, 4 + 2 * len(p.mlp_layers))
     d = p.embed_dim
     scale = 1.0 / math.sqrt(d)
+    if not p.mlp_layers:
+        # pure GMF / matrix factorization: the whole embedding is the
+        # interaction vector and the score is a plain dot product — the
+        # factorized head the full_softmax loss needs (its whole-catalog
+        # logits are one [b, d] @ [d, n_items] matmul).  Discriminated
+        # downstream by the ABSENCE of "out_w".
+        params = {
+            "user_emb": jax.random.normal(keys[0], (n_users, d)) * scale,
+            "item_emb": jax.random.normal(keys[1], (n_items, d)) * scale,
+            "mlp": [],
+            "out_b": jnp.zeros((1,)),
+        }
+        if p.item_bias:
+            params["item_bias"] = jnp.zeros((n_items,))
+        return params
     params = {
         "user_emb": jax.random.normal(keys[0], (n_users, 2 * d)) * scale,
         "item_emb": jax.random.normal(keys[1], (n_items, 2 * d)) * scale,
@@ -93,9 +130,15 @@ def init_ncf(rng: jax.Array, n_users: int, n_items: int, p: NCFParams) -> dict:
 
 def ncf_forward(params: dict, user_idx: jax.Array, item_idx: jax.Array) -> jax.Array:
     """Interaction scores for (user, item) pairs: [batch]."""
-    d = params["user_emb"].shape[1] // 2
     ue = params["user_emb"][user_idx]
     ie = params["item_emb"][item_idx]
+    if "out_w" not in params:  # pure GMF (mlp_layers=())
+        score = jnp.sum(ue * ie, axis=-1) + params["out_b"][0]
+        bias = params.get("item_bias")
+        if bias is not None:
+            score = score + bias[item_idx]
+        return score
+    d = params["user_emb"].shape[1] // 2
     gmf = ue[:, :d] * ie[:, :d]  # [b, d]
     h = jnp.concatenate([ue[:, d:], ie[:, d:]], axis=-1)
     for layer in params["mlp"]:
@@ -114,6 +157,13 @@ def score_all_items(params: dict, user_idx: jax.Array) -> jax.Array:
     The MLP tower broadcasts the user row against the full item table —
     a handful of [n_items, d] matmuls on the MXU.
     """
+    if "out_w" not in params:  # pure GMF (mlp_layers=())
+        score = params["item_emb"] @ params["user_emb"][user_idx]
+        score = score + params["out_b"][0]
+        bias = params.get("item_bias")
+        if bias is not None:
+            score = score + bias
+        return score
     d = params["user_emb"].shape[1] // 2
     n_items = params["item_emb"].shape[0]
     ue = params["user_emb"][user_idx]  # [2d]
@@ -158,6 +208,72 @@ def sampled_softmax_loss(params: dict, user_idx, pos_idx, neg_idx, valid):
     return losses.sum() / jnp.maximum(valid.sum(), 1.0)
 
 
+def full_softmax_loss(params: dict, user_idx, pos_idx, valid,
+                      n_items: int | None = None):
+    """Exact softmax cross-entropy over the WHOLE catalog per positive.
+
+    This is the objective sampled-negative SGD approximates (and the
+    reason implicit ALS — whole-catalog weighted least squares — beat the
+    sampled NCF configs by ~35% MAP on the bench data).  With the
+    pure-GMF head the logits are ONE [b, d] @ [d, n_items] matmul, so
+    "exact" is also the MXU-shaped choice.  Requires init with
+    ``mlp_layers=()``."""
+    if "out_w" in params:
+        raise ValueError(
+            "full_softmax needs the pure-GMF head: set mlp_layers=()"
+        )
+    logits = params["user_emb"][user_idx] @ params["item_emb"].T
+    bias = params.get("item_bias")
+    if bias is not None:
+        logits = logits + bias[None, :]
+    if n_items is not None and n_items < logits.shape[1]:
+        # table rows past the real catalog are sharding padding: they must
+        # not compete in the normalization (or receive gradient)
+        logits = jnp.where(
+            jnp.arange(logits.shape[1])[None, :] < n_items, logits, -jnp.inf
+        )
+    logp = jax.nn.log_softmax(logits, axis=1)
+    picked = jnp.take_along_axis(logp, pos_idx[:, None].astype(jnp.int32), 1)
+    losses = -picked[:, 0] * valid
+    return losses.sum() / jnp.maximum(valid.sum(), 1.0)
+
+
+def wals_loss(params: dict, user_idx, pos_idx, valid, inv_count,
+              alpha: float, n_items: int):
+    """The implicit-ALS objective, exactly, as a stream loss:
+
+        L = sum_u [ sum_{i in P_u} ((1+a)(1 - s_ui)^2 - s_ui^2)
+                    + sum_{j in catalog} s_uj^2 ]  (+ L2 via AdamW decay)
+
+    which is Hu-Koren-Volinsky weighted least squares with confidence
+    1 + a on observed cells and 1 on everything else.  Decomposed over the
+    positive stream: each (u, i) row contributes its observed-cell term
+    once, and carries the user's whole-catalog term scaled by
+    ``inv_count = 1/|P_u|`` so a user appearing |P_u| times contributes it
+    exactly once per epoch.  This is the objective that made implicit ALS
+    beat every sampled NCF config by ~35% MAP on the bench protocol — here
+    it trains the same factorization by AdamW instead of alternating
+    exact solves, on logits that are one [b, d] @ [d, n_items] matmul.
+    Requires the pure-GMF head (``mlp_layers=()``)."""
+    if "out_w" in params:
+        raise ValueError("wals needs the pure-GMF head: set mlp_layers=()")
+    s = params["user_emb"][user_idx] @ params["item_emb"].T
+    bias = params.get("item_bias")
+    if bias is not None:
+        s = s + bias[None, :]
+    mask = (jnp.arange(s.shape[1])[None, :] < n_items).astype(s.dtype)
+    s = s * mask
+    s_pos = jnp.take_along_axis(s, pos_idx[:, None].astype(jnp.int32), 1)[
+        :, 0
+    ]
+    per_row = (
+        (1.0 + alpha) * (1.0 - s_pos) ** 2
+        - s_pos**2
+        + inv_count * jnp.sum(s * s, axis=1)
+    )
+    return (per_row * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+
+
 def param_shardings(mesh: Mesh, params: dict) -> dict:
     """Tables row-sharded over ``model``; everything else replicated.
 
@@ -199,17 +315,25 @@ def _get_epoch_fn(
     mesh_key,
     loss: str = "bpr",
     k_neg: int = 1,
+    weight_decay: float = 0.0,
+    alpha: float = 2.0,
 ):
-    key = (n_steps, batch_size, n_items, lr, mesh_key, loss, k_neg)
+    key = (n_steps, batch_size, n_items, lr, mesh_key, loss, k_neg,
+           weight_decay, alpha)
     hit = _EPOCH_CACHE.get(key)
     if hit is not None:
         return hit
     while len(_EPOCH_CACHE) >= _EPOCH_CACHE_MAX:
         del _EPOCH_CACHE[next(iter(_EPOCH_CACHE))]
-    optimizer = optax.adam(lr)
+    optimizer = (
+        optax.adamw(lr, weight_decay=weight_decay)
+        if weight_decay > 0.0
+        else optax.adam(lr)
+    )
     pair = (
         optimizer,
-        make_epoch_fn(optimizer, n_steps, batch_size, n_items, loss, k_neg),
+        make_epoch_fn(optimizer, n_steps, batch_size, n_items, loss, k_neg,
+                      alpha),
     )
     _EPOCH_CACHE[key] = pair
     return pair
@@ -222,6 +346,7 @@ def make_epoch_fn(
     n_items: int,
     loss: str = "bpr",
     k_neg: int = 1,
+    alpha: float = 2.0,
 ):
     """One compiled program per EPOCH: device-side shuffle, in-step negative
     sampling, and a lax.scan over all batches.
@@ -234,18 +359,25 @@ def make_epoch_fn(
     GSPMD-inserted all-reduce + Adam).
     """
 
-    loss_fn = sampled_softmax_loss if loss == "softmax" else bpr_loss
+    loss_fn = {
+        "softmax": sampled_softmax_loss,
+        "bpr": bpr_loss,
+        "full_softmax": None,  # whole-catalog; handled in body
+        "wals": None,          # whole-catalog; handled in body
+    }[loss]
 
     # donate params+opt_state: the caller always rebinds them, so XLA can
     # update the tables and Adam moments in place instead of copying
     # ~3x the parameter bytes every epoch
     @partial(jax.jit, donate_argnums=(0, 1))
-    def epoch(params, opt_state, u_all, i_all, valid_all, neg_cdf, key):
+    def epoch(params, opt_state, u_all, i_all, valid_all, w_all, neg_cdf,
+              key):
         kperm, kneg = jax.random.split(key)
         perm = jax.random.permutation(kperm, u_all.shape[0])
         us = u_all[perm].reshape(n_steps, batch_size)
         ps = i_all[perm].reshape(n_steps, batch_size)
         vs = valid_all[perm].reshape(n_steps, batch_size)
+        ws = w_all[perm].reshape(n_steps, batch_size)
         # K sampled negatives per positive, drawn PER STEP inside the scan
         # body (a whole-epoch [n_steps, b, K] tensor would pad its minor
         # K dim to 128 lanes — 16x memory blowup at K=8, OOM at ML-20M
@@ -255,14 +387,23 @@ def make_epoch_fn(
 
         def body(carry, xs):
             params, opt_state = carry
-            u, pos, valid, kstep = xs
-            neg = jnp.searchsorted(
-                neg_cdf, jax.random.uniform(kstep, (batch_size, k_neg))
-            ).astype(jnp.int32)
-            neg = jnp.minimum(neg, n_items - 1)
-            step_loss, grads = jax.value_and_grad(loss_fn)(
-                params, u, pos, neg, valid
-            )
+            u, pos, valid, w, kstep = xs
+            if loss == "wals":
+                step_loss, grads = jax.value_and_grad(wals_loss)(
+                    params, u, pos, valid, w, alpha, n_items
+                )
+            elif loss == "full_softmax":
+                step_loss, grads = jax.value_and_grad(full_softmax_loss)(
+                    params, u, pos, valid, n_items
+                )
+            else:
+                neg = jnp.searchsorted(
+                    neg_cdf, jax.random.uniform(kstep, (batch_size, k_neg))
+                ).astype(jnp.int32)
+                neg = jnp.minimum(neg, n_items - 1)
+                step_loss, grads = jax.value_and_grad(loss_fn)(
+                    params, u, pos, neg, valid
+                )
             updates, opt_state = optimizer.update(grads, opt_state, params)
             return (
                 (optax.apply_updates(params, updates), opt_state),
@@ -270,7 +411,7 @@ def make_epoch_fn(
             )
 
         (params, opt_state), losses = jax.lax.scan(
-            body, (params, opt_state), (us, ps, vs, step_keys)
+            body, (params, opt_state), (us, ps, vs, ws, step_keys)
         )
         return params, opt_state, losses.mean()
 
@@ -284,6 +425,7 @@ def train_ncf(
     n_items: int,
     params: NCFParams | None = None,
     mesh: Mesh | None = None,
+    initial_params: dict | None = None,
 ) -> NCFState:
     """Train from positive (user, item) interactions with sampled negatives.
 
@@ -306,6 +448,39 @@ def train_ncf(
     n_items_pad = ((n_items + model_par - 1) // model_par) * model_par
 
     net = init_ncf(jax.random.PRNGKey(p.seed), n_users_pad, n_items_pad, p)
+    if initial_params is not None:
+        # warm start (the NCF paper's pretrain-GMF recipe, He et al. §3.4.1;
+        # the natural pretrainer here is implicit ALS, which trains the
+        # same factorization by exact alternating solves in seconds):
+        # overlay any provided leaves onto the fresh init, zero-padding
+        # table rows up to the sharding-padded shape
+        unknown = set(initial_params) - set(net)
+        if unknown:
+            # a silently-dropped leaf would train from random init — the
+            # exact hard-to-notice quality failure pretraining exists to
+            # prevent
+            raise ValueError(
+                f"initial_params keys {sorted(unknown)} not in the model "
+                f"(have {sorted(net)})"
+            )
+
+        def overlay(name, fresh):
+            given = initial_params.get(name)
+            if given is None:
+                return fresh
+            given = jnp.asarray(given, fresh.dtype)
+            if given.shape == fresh.shape:
+                return given
+            if given.ndim == 2 and given.shape[1] == fresh.shape[1]:
+                return fresh.at[: given.shape[0]].set(given)
+            if given.ndim == 1:
+                return fresh.at[: given.shape[0]].set(given)
+            raise ValueError(
+                f"initial_params[{name!r}] shape {given.shape} does not "
+                f"fit table shape {fresh.shape}"
+            )
+
+        net = {k: overlay(k, v) if k != "mlp" else v for k, v in net.items()}
 
     data_sharding = None
     if mesh is not None:
@@ -339,6 +514,8 @@ def train_ncf(
         mesh,
         loss=p.loss,
         k_neg=max(p.negatives_per_positive, 1),
+        weight_decay=p.weight_decay,
+        alpha=p.alpha,
     )
     opt_state = optimizer.init(net)
 
@@ -348,26 +525,34 @@ def train_ncf(
     u_all = np.zeros(total, np.int32)
     i_all = np.zeros(total, np.int32)
     valid_all = np.zeros(total, np.float32)
+    w_all = np.zeros(total, np.float32)
     u_all[:n_pos] = user_idx
     i_all[:n_pos] = item_idx
     valid_all[:n_pos] = 1.0
+    if p.loss == "wals" and n_pos:
+        # each stream row carries its user's whole-catalog term scaled by
+        # 1/|P_u| so it enters the objective exactly once per epoch
+        ucount = np.bincount(np.asarray(user_idx, np.int64))
+        w_all[:n_pos] = 1.0 / ucount[np.asarray(user_idx, np.int64)]
     if data_sharding is not None:
         if jax.process_count() > 1:
             # every process passes the identical (all-gathered) interaction
             # stream; device memory still holds only the local shards
-            u_all, i_all, valid_all = (
+            u_all, i_all, valid_all, w_all = (
                 jax.make_array_from_callback(
                     x.shape, data_sharding, lambda idx, x=x: x[idx]
                 )
-                for x in (u_all, i_all, valid_all)
+                for x in (u_all, i_all, valid_all, w_all)
             )
         else:
-            u_all, i_all, valid_all = (
+            u_all, i_all, valid_all, w_all = (
                 jax.device_put(x, data_sharding)
-                for x in (u_all, i_all, valid_all)
+                for x in (u_all, i_all, valid_all, w_all)
             )
     else:
-        u_all, i_all, valid_all = map(jnp.asarray, (u_all, i_all, valid_all))
+        u_all, i_all, valid_all, w_all = map(
+            jnp.asarray, (u_all, i_all, valid_all, w_all)
+        )
 
     neg_cdf = jnp.asarray(
         negative_sampling_cdf(item_idx, n_items, p.neg_power)
@@ -377,7 +562,7 @@ def train_ncf(
     for _ in range(p.num_epochs):
         key, ek = jax.random.split(key)
         net, opt_state, last_loss = epoch_fn(
-            net, opt_state, u_all, i_all, valid_all, neg_cdf, ek
+            net, opt_state, u_all, i_all, valid_all, w_all, neg_cdf, ek
         )
     if last_loss is not None:
         jax.block_until_ready(last_loss)

@@ -316,3 +316,216 @@ class TestCheckpointMigration:
             )
         best = max(range(n_i), key=lambda i: scores[i])
         assert r.item_scores[0].item == f"i{best}"
+
+
+class TestFullSoftmax:
+    """Whole-catalog softmax over the pure-GMF head (mlp_layers=()) — the
+    exact objective sampled negatives approximate."""
+
+    def test_learns_clusters(self):
+        rng = np.random.default_rng(0)
+        users, items = _cluster_interactions(rng)
+        state = train_ncf(
+            users, items, n_users=40, n_items=30,
+            params=NCFParams(
+                embed_dim=8, mlp_layers=(), num_epochs=150,
+                batch_size=256, learning_rate=5e-3, loss="full_softmax",
+            ),
+        )
+        scores = np.asarray(score_all_items(state.params, jnp.int32(0)))
+        assert scores[:15].mean() > scores[15:30].mean()
+        scores1 = np.asarray(score_all_items(state.params, jnp.int32(1)))
+        assert scores1[15:30].mean() > scores1[:15].mean()
+
+    def test_requires_pure_gmf_head(self):
+        import pytest as _pytest
+
+        from predictionio_tpu.ops.ncf import full_softmax_loss, init_ncf
+        import jax
+
+        p = NCFParams(embed_dim=8, mlp_layers=(16,))
+        params = init_ncf(jax.random.PRNGKey(0), 4, 5, p)
+        with _pytest.raises(ValueError, match="mlp_layers"):
+            full_softmax_loss(
+                params, jnp.zeros(2, jnp.int32), jnp.zeros(2, jnp.int32),
+                jnp.ones(2),
+            )
+
+    def test_padding_rows_masked_out(self):
+        """Sharding-padded item rows must not compete in the softmax: the
+        masked loss equals the loss on a table truncated to the real
+        catalog, and padded rows get zero gradient."""
+        from predictionio_tpu.ops.ncf import full_softmax_loss, init_ncf
+
+        p = NCFParams(embed_dim=4, mlp_layers=())
+        params = init_ncf(jax.random.PRNGKey(0), 8, 10, p)
+        u = jnp.zeros(3, jnp.int32)
+        pos = jnp.arange(3, dtype=jnp.int32)
+        v = jnp.ones(3)
+        masked = float(full_softmax_loss(params, u, pos, v, n_items=6))
+        truncated = dict(
+            params,
+            item_emb=params["item_emb"][:6],
+            item_bias=params["item_bias"][:6],
+        )
+        exact = float(full_softmax_loss(truncated, u, pos, v, n_items=6))
+        np.testing.assert_allclose(masked, exact, rtol=1e-6)
+        grads = jax.grad(full_softmax_loss)(params, u, pos, v, 6)
+        assert np.abs(np.asarray(grads["item_emb"])[6:]).max() == 0.0
+
+    def test_pure_gmf_serving_paths_agree(self):
+        """device solo, host replica, and batched wave must score pure-GMF
+        models identically."""
+        from predictionio_tpu.data.bimap import BiMap
+        from predictionio_tpu.models.ncf.engine import (
+            NCFAlgorithm,
+            NCFModel,
+            Query,
+        )
+
+        rng = np.random.default_rng(1)
+        users = rng.integers(0, 12, 300).astype(np.int32)
+        items = rng.integers(0, 9, 300).astype(np.int32)
+        state = train_ncf(
+            users, items, 12, 9,
+            params=NCFParams(embed_dim=4, mlp_layers=(), num_epochs=3,
+                             batch_size=64, loss="full_softmax"),
+        )
+        model = NCFModel(
+            state=state,
+            user_vocab=BiMap.from_keys(
+                np.asarray([f"u{u}" for u in range(12)])
+            ),
+            item_vocab=BiMap.from_keys(
+                np.asarray([f"i{i}" for i in range(9)])
+            ),
+        )
+        algo = NCFAlgorithm()
+        solo = algo.predict(model, Query(user="u3", num=4))
+        batch = dict(
+            algo.batch_predict(model, [(0, Query(user="u3", num=4))])
+        )
+        got = [(s.item, round(s.score, 4)) for s in batch[0].item_scores]
+        want = [(s.item, round(s.score, 4)) for s in solo.item_scores]
+        assert got == want and len(got) == 4
+
+
+class TestWALSLoss:
+    """Whole-catalog weighted least squares (the implicit-ALS objective)
+    trained by SGD on the pure-GMF head."""
+
+    def test_learns_clusters(self):
+        rng = np.random.default_rng(0)
+        users, items = _cluster_interactions(rng)
+        state = train_ncf(
+            users, items, n_users=40, n_items=30,
+            params=NCFParams(
+                embed_dim=8, mlp_layers=(), num_epochs=150,
+                batch_size=256, learning_rate=5e-3, loss="wals", alpha=2.0,
+            ),
+        )
+        scores = np.asarray(score_all_items(state.params, jnp.int32(0)))
+        assert scores[:15].mean() > scores[15:30].mean()
+        scores1 = np.asarray(score_all_items(state.params, jnp.int32(1)))
+        assert scores1[15:30].mean() > scores1[:15].mean()
+
+    def test_objective_matches_dense_reference(self):
+        """One wals_loss evaluation over a batch covering every positive
+        must equal the dense Hu-Koren-Volinsky objective computed naively
+        (per mean-normalization)."""
+        import jax
+
+        from predictionio_tpu.ops.ncf import init_ncf, wals_loss
+
+        rng = np.random.default_rng(3)
+        n_u, n_i, alpha = 6, 9, 2.0
+        users = np.repeat(np.arange(n_u), 3).astype(np.int32)
+        # distinct items per user: the stream decomposition is exact for
+        # unique (u, i) pairs (a duplicated pair shifts its confidence
+        # the same way a duplicated COO row shifts ALS's accumulator)
+        items = np.concatenate(
+            [rng.choice(n_i, 3, replace=False) for _ in range(n_u)]
+        ).astype(np.int32)
+        params = init_ncf(
+            jax.random.PRNGKey(0), n_u, n_i,
+            NCFParams(embed_dim=4, mlp_layers=()),
+        )
+        inv_count = (1.0 / np.bincount(users)[users]).astype(np.float32)
+        got = float(
+            wals_loss(
+                params, jnp.asarray(users), jnp.asarray(items),
+                jnp.ones(len(users)), jnp.asarray(inv_count), alpha, n_i,
+            )
+        ) * len(users)
+        S = np.asarray(params["user_emb"]) @ np.asarray(params["item_emb"]).T
+        S = S + np.asarray(params["item_bias"])[None, :]
+        X = np.zeros((n_u, n_i))
+        C = np.ones((n_u, n_i))
+        for u, i in zip(users, items):
+            X[u, i] = 1.0
+            C[u, i] += alpha  # confidence 1 + alpha*count
+        want = float((C * (X - S) ** 2).sum())
+        np.testing.assert_allclose(got, want, rtol=2e-4)
+
+
+class TestALSPretrain:
+    def test_param_validation(self):
+        from predictionio_tpu.models.ncf.engine import NCFAlgorithmParams
+
+        with pytest.raises(ValueError, match="mlpLayers"):
+            NCFAlgorithmParams(pretrain="als", mlp_layers=(16,))
+        with pytest.raises(ValueError, match="unknown pretrain"):
+            NCFAlgorithmParams(pretrain="bogus")
+
+    def test_template_trains_with_als_pretrain(self, storage):
+        """pretrain='als' through the full DASE train path: iALS solves the
+        GMF tables, SGD fine-tunes, the model serves."""
+        from predictionio_tpu.core.base import EngineContext
+        from predictionio_tpu.core.engine import resolve_engine_factory
+        from predictionio_tpu.core.workflow import run_train
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.server.prediction_server import deploy_engine
+        from predictionio_tpu.tools import commands as cmd
+
+        app = cmd.app_new(storage, "ncfwarm")
+        le = storage.l_events()
+        rng = np.random.default_rng(0)
+        for n in range(400):
+            le.insert(
+                Event(
+                    event="rate", entity_type="user",
+                    entity_id=f"u{rng.integers(20)}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{rng.integers(15)}",
+                    properties=DataMap(
+                        {"rating": float(rng.integers(1, 6))}
+                    ),
+                ),
+                app.app.id,
+            )
+        engine = resolve_engine_factory("ncf")()
+        params = engine.params_from_json(
+            {
+                "datasource": {"params": {"appName": "ncfwarm"}},
+                "algorithms": [
+                    {
+                        "name": "ncf",
+                        "params": {
+                            "embedDim": 6, "mlpLayers": [],
+                            "loss": "full_softmax", "numEpochs": 1,
+                            "batchSize": 64, "learningRate": 1e-4,
+                            "pretrain": "als",
+                        },
+                    }
+                ],
+            }
+        )
+        inst = run_train(
+            engine, params, ctx=EngineContext(storage=storage),
+            engine_factory="ncf", storage=storage,
+        )
+        assert inst is not None and inst.status == "COMPLETED"
+        dep = deploy_engine("ncf", storage=storage)
+        _, res = dep.predict(dep.extract_query({"user": "u1", "num": 3}))
+        assert len(res.item_scores) == 3
