@@ -525,10 +525,14 @@ def _train_pallas_mode(user_idx, item_idx, rating, num_users, num_items,
         # nearly halve the cold-train host staging wall time
         from concurrent.futures import ThreadPoolExecutor
 
+        import time as _time
+
+        t0 = _time.perf_counter()
         with ThreadPoolExecutor(2) as pool:
             fu = pool.submit(stage, user_idx, item_idx, num_users_pad)
             fi = pool.submit(stage, item_idx, user_idx, num_items_pad)
             staged = (fu.result(), fi.result())
+        LAST_PLAN_INFO["stage_s"] = round(_time.perf_counter() - t0, 2)
         _STAGE_CACHE[cache_key] = staged
     (up, u_plan, u_oth, u_rat, u_val), (ip, i_plan, i_oth, i_rat, i_val) = (
         staged
