@@ -256,6 +256,16 @@ class ParquetEventStore:
         def js(col, default=""):
             if col is None:
                 return np.full(n, default, object)
+            # fast path: an all-lazy (already-serialized str) column needs
+            # no per-row work at all — bulk ingest and store-to-store
+            # copies hit this, and the 20M-row isinstance loop it replaces
+            # was a measurable slice of the bulk write
+            try:
+                arr = pa.array(col, pa.string())
+                if arr.null_count == 0:  # None rows need the loop's default
+                    return arr
+            except (pa.ArrowInvalid, pa.ArrowTypeError):
+                pass
             out = np.empty(n, object)
             for i2, v in enumerate(col):
                 if isinstance(v, str):  # already-serialized (lazy) rows
@@ -310,6 +320,10 @@ class ParquetEventStore:
         # are ~100x fewer than events at ML scale).  Pairs are coded as
         # ints per column — no string concatenation, no separator pitfalls.
         shard_of = frame_shard_of(frame.entity_type, frame.entity_id, n_shards)
+
+        # sequential per shard: arrow's filter/encode already use its
+        # internal thread pool — an outer pool was measured neutral-to-
+        # negative
         for k in range(n_shards):
             mask = shard_of == k
             if not mask.any():

@@ -154,6 +154,15 @@ def confidence_weights(rating, valid, implicit_prefs: bool, alpha: float, dtype)
     return valid, rating * valid
 
 
+#: rank cutoff for the unrolled structure-of-arrays solve: the unroll
+#: emits ~k^3/6 scalar HLO ops, and past ~16 that graph (x2 half-steps,
+#: inside the training loop body) pushes XLA compile time from seconds
+#: into tens of minutes — measured ~20 min at rank 32 on the remote
+#: compile service.  Wider ranks use the batched lax.linalg kernels:
+#: slower per step (the docstring below) but a constant-size program.
+_SOA_MAX_RANK = 16
+
+
 def _solve_factors(A, b, counts, reg, scale_reg, gram=None):
     """Solve (A + reg' I [+ gram]) x = b batched over the leading axis.
 
@@ -163,14 +172,25 @@ def _solve_factors(A, b, counts, reg, scale_reg, gram=None):
     kernels pad each tiny matrix to full vector tiles and serialize the
     triangular solves — measured 230-260 ms for n=138k, k=10 on v5e, vs
     ~74 MFLOPs of real work; the SoA form runs in a few ms.  The unrolled
-    loops are over the STATIC rank (k <= 32), so the program stays a flat
-    fused elementwise graph.  No pivoting: the operands are SPD + ridge.
+    loops are over the STATIC rank (gated at ``_SOA_MAX_RANK`` — the
+    unroll is quadratic-to-cubic in PROGRAM SIZE, which is compile time),
+    so the program stays a flat fused elementwise graph.  No pivoting:
+    the operands are SPD + ridge.
     """
     k = b.shape[-1]
     reg_eff = reg * jnp.maximum(counts, 1.0) if scale_reg else jnp.full_like(counts, reg)
     lhs = A + reg_eff[:, None, None] * jnp.eye(k, dtype=A.dtype)
     if gram is not None:
         lhs = lhs + gram
+    if k > _SOA_MAX_RANK:
+        L = jnp.linalg.cholesky(lhs)
+        y = jax.lax.linalg.triangular_solve(
+            L, b[..., None], left_side=True, lower=True
+        )
+        x = jax.lax.linalg.triangular_solve(
+            L, y, left_side=True, lower=True, transpose_a=True
+        )
+        return x[..., 0]
     At = jnp.transpose(lhs, (1, 2, 0))  # [k, k, n]
     bT = jnp.transpose(b, (1, 0))       # [k, n]
     L = [[None] * k for _ in range(k)]
@@ -413,9 +433,9 @@ def _train_pallas(user_idx, item_idx, rating, num_users, num_items,
     from predictionio_tpu.ops import als_pallas
 
     # mode select: the fused single-grid kernel streams the transposed
-    # gather output ([nt, k, T] f32) per half-step; fall back to the
-    # chunk-scan when that transient would crowd HBM or the update rows
-    # would not fit VMEM (rank > 22)
+    # gather output ([nt, k, T] f32) per half-step; any rank runs fused
+    # (wide ranks add width slabs, not VMEM), so the only reason to fall
+    # back to the chunk-scan is the gather transient crowding HBM
     mode = p.pallas_mode
     if mode == "auto":
         est_rows = int(len(user_idx) * 1.06) + als_pallas.T  # ~pad factor
@@ -430,12 +450,11 @@ def _train_pallas(user_idx, item_idx, rating, num_users, num_items,
         # so padding cannot exceed the sublane round-up.)
         k_pad = (p.rank + 7) // 8 * 8
         fused_bytes = est_rows * 4 * (2 * k_pad + 2 * 8)
-        fits_vmem = als_pallas.row_width(p.rank) <= als_pallas.FUSED_MAX_WIDTH
-        mode = (
-            "fused"
-            if fits_vmem and fused_bytes <= 4 << 30
-            else "chunked"
-        )
+        # budget ~half of a v5e's 16G HBM for the staged streams + the
+        # per-half-step gather transient (leaves room for XLA
+        # double-buffering, the accumulator, and co-tenants); the OOM
+        # ladder catches an underestimate by falling back to chunked
+        mode = "fused" if fused_bytes <= 8 << 30 else "chunked"
 
     ladder = [(mode, False)]
     if mode == "fused":

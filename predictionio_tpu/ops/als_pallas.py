@@ -194,26 +194,28 @@ def make_segment_accum(
     )
 
 
-#: fused-path VMEM budget: A/B/updT (+bf16 splits) are [width, T] tiles, so
-#: width*T*4*~5 bytes must fit VMEM alongside double-buffered inputs —
-#: width 512 (rank <= 22) keeps the working set under ~10 MB
-FUSED_MAX_WIDTH = 512
+#: width-slab size of the fused kernel: each grid step builds a
+#: [SLAB_W, T] slice of the transposed update rows, so VMEM per block is
+#: ~SLAB_W*T*4*5 bytes regardless of rank (wide ranks add grid steps,
+#: not VMEM or compile size)
+SLAB_W = 128
 
 
-def _make_fused_kernel(k: int, width: int, precision: str):
+def _make_fused_kernel(k: int, precision: str):
     """Whole-stream fused kernel in TRANSPOSED orientation.
 
     Every HBM-resident per-row array is layout-clean (minor dim T=1024 or
-    128): the opposite factors arrive pre-gathered as ``cv_t [k, nt, T]``
-    and the static weights as ``wrv [3, nt, T]`` — there is NO tall-narrow
+    128): the opposite factors arrive pre-gathered as ``cv_t [nt, k, T]``
+    and the static weights as ``wrv [nt, 3, T]`` — there is NO tall-narrow
     ``[P, <128]`` array anywhere, which is what turned the round-4 fused
     path into 57G of T(8,128)-padded HLO temps (BENCH_r04).
 
     The flat update rows are built IN VMEM as their transpose
-    ``updT [width, T]`` without any sublane concatenation: two static
-    one-hot selection matrices (pa picks component a = r//k, pb picks
-    b = r%k, both materialized from iota compares) turn the outer-product
-    block, the rhs block, and the count row into
+    ``updT [SLAB_W, T]`` (one 128-row slab of the full row_width per grid
+    step) without any sublane concatenation: two static one-hot selection
+    matrices (pa picks component a = r//k, pb picks b = r%k, both
+    materialized from iota compares at the slab's global row offset) turn
+    the outer-product block, the rhs block, and the count row into
 
         updT = (pa@cv) * ((pb@cv) * w + sel_rhs * rhs) + sel_val * val
 
@@ -221,16 +223,22 @@ def _make_fused_kernel(k: int, width: int, precision: str):
     is zero there), row k*k+k gets val, the rest 0.  The selection matmuls
     run at Precision.HIGHEST (exact for f32, ~2.6 MFLOP — noise).
 
-    With the stream sorted by destination block and ``out_specs`` indexed
-    by ``block_map``, each output block stays VMEM-resident across all its
-    tiles and is written to HBM exactly once — the chunk scan's per-chunk
-    accumulator read-modify-write (71 MB per chunk per half-step at
-    ML-20M) disappears entirely.
+    The grid is (n_slabs, n_tiles) with the SLAB AXIS OUTER: within one
+    slab the stream sweeps tiles in block-sorted order, so each output
+    block stays VMEM-resident across all its tiles and is written to HBM
+    exactly once — the chunk scan's per-chunk accumulator
+    read-modify-write (71 MB per chunk per half-step at ML-20M)
+    disappears entirely.  Wide ranks (rank 32 -> 9 slabs) re-read the
+    input streams once per slab instead of blowing up the kernel's VMEM
+    footprint or its Mosaic compile time (the monolithic width-1152
+    chunked kernel took ~25 min to compile; each slab kernel is the same
+    small program at every rank).
     """
     kk = k * k
 
     def kernel(block_map_ref, first_ref, seg_ref, cv_ref, wrv_ref, out_ref):
-        i = pl.program_id(0)
+        s = pl.program_id(0)
+        i = pl.program_id(1)
         seg = seg_ref[0]  # [T//128, 128] int32
         onehot = (
             seg[:, :, None]
@@ -239,8 +247,8 @@ def _make_fused_kernel(k: int, width: int, precision: str):
         cv = cv_ref[0]    # [k, T]
         wrv = wrv_ref[0]  # [3, T]
         w, rhs, val = wrv[0:1, :], wrv[1:2, :], wrv[2:3, :]
-        r = jax.lax.broadcasted_iota(jnp.int32, (width, k), 0)
-        c = jax.lax.broadcasted_iota(jnp.int32, (width, k), 1)
+        r = jax.lax.broadcasted_iota(jnp.int32, (SLAB_W, k), 0) + s * SLAB_W
+        c = jax.lax.broadcasted_iota(jnp.int32, (SLAB_W, k), 1)
         # select between int32 index maps, not between booleans: Mosaic
         # cannot truncate an i8 select result to i1
         a_idx = jnp.where(r < kk, r // k, r - kk)
@@ -256,7 +264,9 @@ def _make_fused_kernel(k: int, width: int, precision: str):
             pb.astype(jnp.float32), cv, dimension_numbers=dn_sel,
             precision=hp, preferred_element_type=jnp.float32,
         )
-        r1 = jax.lax.broadcasted_iota(jnp.int32, (width, 1), 0)
+        r1 = (
+            jax.lax.broadcasted_iota(jnp.int32, (SLAB_W, 1), 0) + s * SLAB_W
+        )
         sel_rhs = ((r1 >= kk) & (r1 < kk + k)).astype(jnp.float32)
         sel_val = (r1 == kk + k).astype(jnp.float32)
         updT = A * (B * w + sel_rhs * rhs) + sel_val * val
@@ -302,7 +312,8 @@ def make_fused_accum(
 ):
     """pallas_call over the WHOLE stream: (block_map[nt], first[nt],
     seg3[nt, T//128, 128], cv_t[nt, k, T], wrv[nt, 3, T]) -> TRANSPOSED
-    accumulator [n_blocks * width, S] (blocks of [width, S]).
+    accumulator [n_blocks * width, S] (SLAB_W-row blocks, width-slab
+    grid axis outer so blocks revisit consecutively within a slab).
 
     The per-tile operands are [nt, small, T]: Mosaic wants the last two
     block dims divisible by (8, 128) or equal to the array dims, so the
@@ -312,23 +323,21 @@ def make_fused_accum(
     if precision not in ("highest", "hilo", "bf16"):
         raise ValueError(f"unknown precision {precision!r}")
     width = row_width(rank)
-    if width > FUSED_MAX_WIDTH:
-        raise ValueError(
-            f"fused path supports row_width <= {FUSED_MAX_WIDTH} "
-            f"(rank <= 22); got width {width} — use the chunked path"
-        )
+    n_slabs = width // SLAB_W
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(n_tiles,),
+        grid=(n_slabs, n_tiles),
         in_specs=[
-            pl.BlockSpec((1, T // 128, 128), lambda i, bm, fr: (i, 0, 0)),
-            pl.BlockSpec((1, rank, T), lambda i, bm, fr: (i, 0, 0)),
-            pl.BlockSpec((1, 3, T), lambda i, bm, fr: (i, 0, 0)),
+            pl.BlockSpec((1, T // 128, 128), lambda s, i, bm, fr: (i, 0, 0)),
+            pl.BlockSpec((1, rank, T), lambda s, i, bm, fr: (i, 0, 0)),
+            pl.BlockSpec((1, 3, T), lambda s, i, bm, fr: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((width, S), lambda i, bm, fr: (bm[i], 0)),
+        out_specs=pl.BlockSpec(
+            (SLAB_W, S), lambda s, i, bm, fr: (bm[i] * n_slabs + s, 0)
+        ),
     )
     return pl.pallas_call(
-        _make_fused_kernel(rank, width, precision),
+        _make_fused_kernel(rank, precision),
         out_shape=jax.ShapeDtypeStruct((n_blocks * width, S), jnp.float32),
         grid_spec=grid_spec,
         interpret=interpret,

@@ -3,6 +3,8 @@
 import numpy as np
 import pytest
 
+import jax.numpy as jnp
+
 from predictionio_tpu.ops.als import ALSParams, train_als
 from predictionio_tpu.parallel.mesh import MeshConfig, default_mesh, make_mesh
 
@@ -139,3 +141,46 @@ class TestOOMFallbackLadder:
                 np.zeros(4, np.int64), np.zeros(4, np.int64),
                 np.ones(4, np.float32), 4, 4, p, np.float32,
             )
+
+
+class TestSolveFactors:
+    def test_wide_rank_batched_solve_matches_numpy(self):
+        """Ranks above _SOA_MAX_RANK route through batched lax.linalg; the
+        solutions must match a dense numpy solve."""
+        from predictionio_tpu.ops.als import _SOA_MAX_RANK, _solve_factors
+
+        rng = np.random.default_rng(0)
+        n, k = 40, _SOA_MAX_RANK + 4
+        M = rng.standard_normal((n, k, k)).astype(np.float32)
+        A = M @ M.transpose(0, 2, 1)  # SPD-ish, ridge added inside
+        b = rng.standard_normal((n, k)).astype(np.float32)
+        counts = rng.integers(1, 9, n).astype(np.float32)
+        got = np.asarray(_solve_factors(
+            jnp.asarray(A), jnp.asarray(b), jnp.asarray(counts), 0.1, True
+        ))
+        lhs = A + (0.1 * np.maximum(counts, 1.0))[:, None, None] * np.eye(k)
+        want = np.linalg.solve(lhs, b[..., None])[..., 0]
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    def test_narrow_and_wide_agree_at_boundary(self):
+        from predictionio_tpu.ops import als as als_mod
+
+        rng = np.random.default_rng(1)
+        n, k = 16, 8
+        M = rng.standard_normal((n, k, k)).astype(np.float32)
+        A = M @ M.transpose(0, 2, 1)
+        b = rng.standard_normal((n, k)).astype(np.float32)
+        counts = np.ones(n, np.float32)
+        soa = np.asarray(als_mod._solve_factors(
+            jnp.asarray(A), jnp.asarray(b), jnp.asarray(counts), 0.05, False
+        ))
+        orig = als_mod._SOA_MAX_RANK
+        try:
+            als_mod._SOA_MAX_RANK = 4  # force the batched path
+            batched = np.asarray(als_mod._solve_factors(
+                jnp.asarray(A), jnp.asarray(b), jnp.asarray(counts), 0.05,
+                False
+            ))
+        finally:
+            als_mod._SOA_MAX_RANK = orig
+        np.testing.assert_allclose(soa, batched, rtol=2e-3, atol=2e-3)

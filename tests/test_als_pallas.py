@@ -193,8 +193,47 @@ def test_segment_stats_fused_matches_scatter_semantics():
         np.testing.assert_allclose(acc[:, k * k + k], c_ref, rtol=1e-5)
 
 
-def test_fused_width_cap():
+def test_fused_wide_rank_slabs():
+    """Wide ranks run fused via the width-slab grid axis: rank 32 builds
+    1152/128 = 9 slabs per tile and must match the scatter reference."""
     assert ap.row_width(10) == 128
-    assert ap.row_width(22) == 512  # largest fused-eligible rank
-    with pytest.raises(ValueError, match="chunked"):
-        ap.make_fused_accum(4, 2, rank=32)
+    assert ap.row_width(32) == 1152
+    rng = np.random.default_rng(7)
+    n, nseg, noth, k = 2000, 256, 40, 17  # width 384 -> 3 slabs
+    seg = rng.integers(0, 250, n)
+    oth = rng.integers(0, noth, n).astype(np.int32)
+    rat = rng.uniform(-2, 2, n).astype(np.float32)
+    factors = rng.standard_normal((noth, k)).astype(np.float32)
+    plan = ap.build_plan(seg.astype(np.int64), nseg)
+    nt = plan.n_tiles
+    oth_p = oth[plan.dest_perm].copy()
+    rat_p = rat[plan.dest_perm].copy()
+    val_p = np.ones(plan.padded_len, np.float32)
+    oth_p[plan.pad_mask] = 0
+    rat_p[plan.pad_mask] = 0
+    val_p[plan.pad_mask] = 0
+    wrv = ap.make_wrv(
+        jnp.asarray(rat_p.reshape(nt, ap.T)),
+        jnp.asarray(val_p.reshape(nt, ap.T)), False, 1.0,
+    )
+    acc = ap.segment_stats_fused(
+        (jnp.asarray(plan.block_map), jnp.asarray(plan.first),
+         jnp.asarray(plan.seg3)),
+        jnp.asarray(oth_p.reshape(nt, ap.T)), wrv, jnp.asarray(factors),
+        nt, plan.n_blocks, interpret=True,
+    )
+    acc = np.asarray(acc)[:nseg]
+    v = factors[oth]
+    A_ref = np.zeros((nseg, k, k), np.float32)
+    b_ref = np.zeros((nseg, k), np.float32)
+    c_ref = np.zeros(nseg, np.float32)
+    np.add.at(A_ref, seg, v[:, :, None] * v[:, None, :])
+    np.add.at(b_ref, seg, v * rat[:, None])
+    np.add.at(c_ref, seg, 1.0)
+    np.testing.assert_allclose(
+        acc[:, : k * k].reshape(nseg, k, k), A_ref, rtol=1e-4, atol=2e-3
+    )
+    np.testing.assert_allclose(
+        acc[:, k * k : k * k + k], b_ref, rtol=1e-4, atol=2e-3
+    )
+    np.testing.assert_allclose(acc[:, k * k + k], c_ref, rtol=1e-5)
