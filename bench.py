@@ -879,6 +879,10 @@ def main() -> None:
             metrics["roofline_tflop_eq_per_iter"] = round(fl, 3)
             metrics["roofline_achieved_tflop_s"] = round(fl / it_s, 2)
             metrics["als_pallas_mode"] = pi.get("mode", "?")
+            if "stage_s" in pi:
+                # host staging share of the cold number (sort + block-pad
+                # + narrow-encoded upload submission)
+                metrics["als_stage_s"] = pi["stage_s"]
             log(
                 f"# roofline/iter: ~{gb:.1f} GB moved -> {gb / it_s:.0f} GB/s "
                 f"achieved (HBM peak ~819); one-hot MXU {fl:.2f} TFLOP(eq) "

@@ -529,3 +529,29 @@ class TestALSPretrain:
         dep = deploy_engine("ncf", storage=storage)
         _, res = dep.predict(dep.extract_query({"user": "u1", "num": 3}))
         assert len(res.item_scores) == 3
+
+
+class TestWholeCatalogSharded:
+    """The whole-catalog losses must compile and learn with tables
+    row-sharded over the model axis and batches over data (the logits
+    matmul against a sharded item table becomes a GSPMD collective)."""
+
+    @pytest.mark.parametrize("loss", ["full_softmax", "wals"])
+    def test_sharded_whole_catalog_losses(self, loss):
+        from predictionio_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(axes={"data": 2, "model": 2}))
+        rng = np.random.default_rng(0)
+        users, items = _cluster_interactions(rng)
+        state = train_ncf(
+            users, items, n_users=40, n_items=30,
+            params=NCFParams(
+                embed_dim=8, mlp_layers=(), num_epochs=120,
+                batch_size=256, learning_rate=5e-3, loss=loss,
+            ),
+            mesh=mesh,
+        )
+        assert not state.params["user_emb"].sharding.is_fully_replicated
+        scores = np.asarray(score_all_items(state.params, jnp.int32(0)))
+        assert np.isfinite(scores).all()
+        assert scores[:15].mean() > scores[15:30].mean()
