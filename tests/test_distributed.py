@@ -123,12 +123,13 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_train_matches_single_process(tmp_path):
-    u, i, r = write_parquet_events(tmp_path / "events")
-
+def run_two_workers(worker_src: str, argv: list, label: str = "worker",
+                    timeout: int = 600) -> None:
+    """Launch 2 jax.distributed worker processes (2 virtual CPU devices
+    each) and triage the join: constrained environments (no coordinator,
+    wedged workers) SKIP, real worker failures RAISE with stderr.  The one
+    home of the env contract every multi-process test shares."""
     port = free_port()
-    out_path = tmp_path / "factors.npz"
     procs = []
     for pid in (0, 1):
         env = dict(
@@ -141,25 +142,30 @@ def test_two_process_train_matches_single_process(tmp_path):
         env.pop("JAX_PLATFORMS", None)  # set inside the worker instead
         procs.append(
             subprocess.Popen(
-                [sys.executable, "-c", _WORKER, str(tmp_path / "events"),
-                 str(out_path)],
+                [sys.executable, "-c", worker_src, *[str(a) for a in argv]],
                 env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                 text=True,
             )
         )
-    outs = []
     try:
-        for p in procs:
-            outs.append(p.communicate(timeout=600))
+        outs = [p.communicate(timeout=timeout) for p in procs]
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
         pytest.skip("distributed workers timed out (constrained environment)")
-    for p, (out, err) in zip(procs, outs):
+    for p, (_out, err) in zip(procs, outs):
         if p.returncode != 0:
             if "distributed" in err.lower() or "coordinator" in err.lower():
                 pytest.skip(f"jax.distributed unavailable: {err[-300:]}")
-            raise AssertionError(f"worker failed:\n{err[-3000:]}")
+            raise AssertionError(f"{label} failed:\n{err[-3000:]}")
+
+
+@pytest.mark.slow
+def test_two_process_train_matches_single_process(tmp_path):
+    u, i, r = write_parquet_events(tmp_path / "events")
+
+    out_path = tmp_path / "factors.npz"
+    run_two_workers(_WORKER, [tmp_path / "events", out_path])
     assert out_path.exists()
 
     # single-process reference on the full data
@@ -333,39 +339,8 @@ def test_two_process_sql_store_train_parity(tmp_path):
     )
     client.close()
 
-    port = free_port()
     out_path = tmp_path / "factors.npz"
-    procs = []
-    for pid in (0, 1):
-        env = dict(
-            os.environ,
-            XLA_FLAGS="--xla_force_host_platform_device_count=2",
-            PIO_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-            PIO_NUM_PROCESSES="2",
-            PIO_PROCESS_ID=str(pid),
-        )
-        env.pop("JAX_PLATFORMS", None)
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, "-c", _SQL_WORKER, str(db_path),
-                 str(out_path)],
-                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                text=True,
-            )
-        )
-    outs = []
-    try:
-        for p in procs:
-            outs.append(p.communicate(timeout=600))
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        pytest.skip("distributed workers timed out (constrained environment)")
-    for p, (out, err) in zip(procs, outs):
-        if p.returncode != 0:
-            if "distributed" in err.lower() or "coordinator" in err.lower():
-                pytest.skip(f"jax.distributed unavailable: {err[-300:]}")
-            raise AssertionError(f"worker failed:\n{err[-3000:]}")
+    run_two_workers(_SQL_WORKER, [db_path, out_path])
     assert out_path.exists()
 
     from predictionio_tpu.ops.als import ALSParams, train_als
@@ -437,36 +412,8 @@ def test_two_process_ncf_sharded_tables(tmp_path):
     """NCF with embedding tables row-sharded ACROSS 2 OS processes (dp=2 x
     mp=2 over 4 devices) must train and learn the planted cluster
     structure — the multi-host embedding-sharding story end to end."""
-    port = free_port()
     out_path = tmp_path / "scores.npz"
-    procs = []
-    for pid in (0, 1):
-        env = dict(
-            os.environ,
-            XLA_FLAGS="--xla_force_host_platform_device_count=2",
-            PIO_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-            PIO_NUM_PROCESSES="2",
-            PIO_PROCESS_ID=str(pid),
-        )
-        env.pop("JAX_PLATFORMS", None)
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, "-c", _NCF_WORKER, str(out_path)],
-                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                text=True,
-            )
-        )
-    try:
-        outs = [p.communicate(timeout=600) for p in procs]
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        pytest.skip("distributed workers timed out (constrained environment)")
-    for p, (out, err) in zip(procs, outs):
-        if p.returncode != 0:
-            if "distributed" in err.lower() or "coordinator" in err.lower():
-                pytest.skip(f"jax.distributed unavailable: {err[-300:]}")
-            raise AssertionError(f"ncf worker failed:\n{err[-3000:]}")
+    run_two_workers(_NCF_WORKER, [out_path], label="ncf worker")
     got = np.load(out_path)
     # user 0 (even cluster) prefers low items; user 1 prefers high items
     assert got["s0"][:15].mean() > got["s0"][15:30].mean()
@@ -559,37 +506,8 @@ def test_two_process_remote_daemon_train_parity(tmp_path):
             1,
         )
 
-        port = free_port()
         out_path = tmp_path / "factors.npz"
-        procs = []
-        for pid in (0, 1):
-            env = dict(
-                os.environ,
-                XLA_FLAGS="--xla_force_host_platform_device_count=2",
-                PIO_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-                PIO_NUM_PROCESSES="2",
-                PIO_PROCESS_ID=str(pid),
-            )
-            env.pop("JAX_PLATFORMS", None)
-            procs.append(
-                subprocess.Popen(
-                    [sys.executable, "-c", _REMOTE_WORKER, url,
-                     str(out_path)],
-                    env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                    text=True,
-                )
-            )
-        try:
-            outs = [p.communicate(timeout=600) for p in procs]
-        except subprocess.TimeoutExpired:
-            for p in procs:
-                p.kill()
-            pytest.skip("distributed workers timed out (constrained environment)")
-        for p, (out, err) in zip(procs, outs):
-            if p.returncode != 0:
-                if "distributed" in err.lower() or "coordinator" in err.lower():
-                    pytest.skip(f"jax.distributed unavailable: {err[-300:]}")
-                raise AssertionError(f"worker failed:\n{err[-3000:]}")
+        run_two_workers(_REMOTE_WORKER, [url, out_path])
         assert out_path.exists()
 
         from predictionio_tpu.ops.als import ALSParams, train_als
@@ -607,3 +525,62 @@ def test_two_process_remote_daemon_train_parity(tmp_path):
         np.testing.assert_allclose(got_scores, ref_scores, rtol=0.05, atol=0.05)
     finally:
         daemon.shutdown()
+
+
+_NCF_WALS_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from predictionio_tpu.parallel.mesh import (
+    default_mesh, initialize_distributed,
+)
+
+initialize_distributed()
+assert jax.process_count() == 2, jax.process_count()
+
+from predictionio_tpu.ops.ncf import NCFParams, train_ncf, score_all_items
+
+out_path = sys.argv[1]
+rank = int(os.environ["PIO_PROCESS_ID"])
+
+# the train_ncf multi-process contract: every process passes the
+# IDENTICAL full interaction stream (seed-deterministic here, the
+# process_allgather role); device memory holds only local shards
+rng = np.random.default_rng(7)
+users, items = [], []
+for u in range(40):
+    lo, hi = (0, 15) if u % 2 == 0 else (15, 30)
+    for i in rng.choice(np.arange(lo, hi), 6, replace=False):
+        users.append(u); items.append(int(i))
+users = np.array(users, np.int32); items = np.array(items, np.int32)
+
+mesh = default_mesh()  # {"data": 4} over 2 procs x 2 local devices
+state = train_ncf(
+    users, items, n_users=40, n_items=30,
+    params=NCFParams(embed_dim=8, mlp_layers=(), loss="wals",
+                     num_epochs=120, batch_size=64, learning_rate=5e-3),
+    mesh=mesh,
+)
+if rank == 0:
+    s0 = np.asarray(score_all_items(state.params, 0))
+    s1 = np.asarray(score_all_items(state.params, 1))
+    np.savez(out_path, s0=s0, s1=s1)
+print("done", rank, file=sys.stderr)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_ncf_train_learns(tmp_path):
+    """Distributed NCF with the wals whole-catalog loss: 2 OS processes,
+    one 4-device data mesh, GSPMD-sharded tables — the deep-rec analog of
+    the ALS multi-process test.  The joined train must learn the cluster
+    structure (even users prefer low items)."""
+    out_path = tmp_path / "ncf_scores.npz"
+    run_two_workers(_NCF_WALS_WORKER, [out_path], label="ncf wals worker")
+    got = np.load(out_path)
+    assert np.isfinite(got["s0"]).all() and np.isfinite(got["s1"]).all()
+    assert got["s0"][:15].mean() > got["s0"][15:30].mean()
+    assert got["s1"][15:30].mean() > got["s1"][:15].mean()
