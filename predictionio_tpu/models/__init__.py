@@ -6,6 +6,7 @@ Parity targets (reference examples/):
   - classification: Naive Bayes / logistic regression (scala-parallel-classification)
   - ecommerce: ALS + business-rule filters (scala-parallel-ecommercerecommendation)
   - ncf: deep two-tower/NCF with sharded embeddings (pypio deep-rec config)
+  - external: serve externally-trained models through DASE (e2 PythonEngine)
 
 Importing this package registers every bundled engine factory (the reflective
 EngineFactory discovery analog, workflow/WorkflowUtils.scala:47).

@@ -9,12 +9,18 @@ model-parallel component of the framework (SURVEY.md §2.9):
     into collective lookups over ICI;
   - the interaction batch is sharded over ``data`` (pure data parallelism);
   - MLP weights are replicated; their gradients all-reduce automatically;
-  - the whole optimization step (forward, BPR loss, backward, Adam update)
-    is ONE jit program — no per-step host round trips.
+  - the whole optimization step (forward, loss, backward, Adam/AdamW
+    update) is ONE jit program — no per-step host round trips.
 
 Architecture follows the NCF paper shape: a GMF branch (elementwise product
 of user/item vectors) and an MLP branch (concat -> relu stack), fused by a
-final linear layer.  Training uses BPR ranking loss over sampled negatives.
+final linear layer; ``mlp_layers=()`` selects a pure-GMF / matrix-
+factorization head whose whole-catalog score is one matmul.  Losses: BPR
+or sampled softmax over K sampled negatives, and — on the pure-GMF head —
+exact whole-catalog ``full_softmax`` and ``wals`` (the implicit-ALS
+objective trained by SGD).  ``train_ncf(initial_params=...)`` warm-starts
+from pretrained tables (the paper's §3.4.1 recipe; implicit ALS is the
+natural GMF pretrainer).
 """
 
 from __future__ import annotations
