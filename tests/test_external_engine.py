@@ -143,3 +143,49 @@ def test_registered_model_reloads_from_store(storage):
         deployed.extract_query({"v": 21})
     )
     assert result.to_json_dict() == {"doubled": 42}
+
+
+def test_external_engine_concurrent_waves_keep_row_alignment(storage):
+    """CONCURRENT queries through the aio server's MicroBatcher: waves
+    bigger than one must hand each client its OWN answer (a permuted
+    reassembly in predict_batch would swap predictions between clients —
+    solo-query tests cannot catch that)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    register_external_model(
+        TinyClassifier([1.0, -1.0], 0.0),
+        feature_columns=("a", "b"),
+        columns=("prediction",),
+        storage=storage,
+    )
+    from predictionio_tpu.server.prediction_server import (
+        create_prediction_server,
+    )
+
+    server = create_prediction_server(
+        "external", host="127.0.0.1", port=0, storage=storage,
+        server_kind="aio",
+    ).start_background()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+
+        def ask(n):
+            # distinct per-client expectation: prediction = (a > b)
+            a, b = (float(n), 0.0) if n % 2 else (0.0, float(n + 1))
+            req = urllib.request.Request(
+                base + "/queries.json",
+                data=json.dumps({"a": a, "b": b}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            got = json.loads(urllib.request.urlopen(req, timeout=30).read())
+            return n, got["prediction"], n % 2
+
+        with ThreadPoolExecutor(16) as pool:
+            results = list(pool.map(ask, range(1, 49)))
+        for n, got, want in results:
+            assert got == want, (n, got, want)
+        # the batcher actually coalesced: at least one wave held >1 query
+        waves = server.app.microbatcher.wave_sizes
+        assert any(size > 1 for size in waves), waves
+    finally:
+        server.shutdown()
