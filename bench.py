@@ -603,6 +603,15 @@ print(server.port, flush=True)
 sys.stdin.readline()  # parent closes stdin to stop us
 sizes = sorted(app.microbatcher.wave_sizes.items())
 print(f"waves {sizes}", file=sys.stderr, flush=True)
+# one-line decomposed-latency snapshot (p50/p95/p99 from the log buckets):
+# request latency split into queue wait vs device time per wave
+from predictionio_tpu.obs.metrics import REGISTRY, render_json_line
+print("metrics " + render_json_line(REGISTRY, [
+    "pio_request_latency_seconds",
+    "pio_microbatch_queue_wait_seconds",
+    "pio_microbatch_device_seconds",
+    "pio_microbatch_batch_size",
+]), file=sys.stderr, flush=True)
 server.shutdown()
 """
 
@@ -702,15 +711,24 @@ def serving_p50_concurrent(model, num_users, clients=32, per_client=40):
         # MEDIAN round by p99: robust to one scheduler-noise round without
         # cherry-picking the best (single shared core)
         med = sorted(rounds, key=lambda r: r["p99_ms"])[len(rounds) // 2]
-        return med["p50_ms"], med["p99_ms"]
-    finally:
+        hist: dict = {}
         try:
-            srv.stdin.close()
-            _, err = srv.communicate(timeout=10)
+            # communicate(input=...) writes the stop line AND closes stdin;
+            # closing stdin first makes communicate() raise ValueError on
+            # the already-closed pipe (and silently lose stderr)
+            _, err = srv.communicate(input="\n", timeout=10)
             for line in err.splitlines():
                 if line.startswith("waves "):
                     log(f"# microbatch {line}")
+                elif line.startswith("metrics "):
+                    hist = json.loads(line[len("metrics "):])
+                    log("# serving_histograms "
+                        + json.dumps(hist, sort_keys=True))
         except Exception:
+            srv.kill()
+        return med["p50_ms"], med["p99_ms"], hist
+    finally:
+        if srv.poll() is None:
             srv.kill()
         os.unlink(blob_path)
 
@@ -1147,10 +1165,14 @@ def main() -> None:
     def sec_als_serving():
         model = build_als_model(C.state, num_users, num_items)
         p50_single = serving_p50_single(model, num_users)
-        p50_conc, p99_conc = serving_p50_concurrent(model, num_users)
+        p50_conc, p99_conc, hist = serving_p50_concurrent(model, num_users)
         metrics["serving_p50_ms"] = round(p50_single, 3)
         metrics["serving_p50_concurrent32_ms"] = round(p50_conc, 3)
         metrics["serving_p99_concurrent32_ms"] = round(p99_conc, 3)
+        if hist:
+            # decomposed serving latency: request p50/p95/p99 by
+            # route/status + queue-wait vs device-time from the registry
+            metrics["serving_histograms"] = hist
         log(
             f"# serving_p50={p50_single:.3f}ms "
             f"serving_p50_concurrent32={p50_conc:.3f}ms "

@@ -190,21 +190,36 @@ class Engine:
         The same instances that trained are returned so train-time state is
         available to make_persistent_model (the workflow uses this form).
         Returns empty models when stopped early by the flags.
+
+        Each DASE stage runs inside an observability span, so a training
+        run decomposes into datasource-read / prepare / per-algorithm train
+        time (``pio_span_seconds``; run_train logs the breakdown).
         """
+        from predictionio_tpu.obs.tracing import trace
+
         ds, prep, algos, _ = self.instantiate(params)
-        td = ds.read_training(ctx)
+        with trace("train.datasource.read"):
+            td = ds.read_training(ctx)
         if not skip_sanity_check:
             run_sanity_check(td)
         if stop_after_read:
             return algos, []
-        pd = prep.prepare(ctx, td)
+        with trace("train.preparator.prepare"):
+            pd = prep.prepare(ctx, td)
         if not skip_sanity_check:
             run_sanity_check(pd)
         if stop_after_prepare:
             return algos, []
+        algo_names = [name for name, _ in params.algorithms] or [""]
         models = []
-        for algo in algos:
-            model = algo.train(ctx, pd)
+        for idx, algo in enumerate(algos):
+            label = (
+                algo_names[idx]
+                if idx < len(algo_names) and algo_names[idx]
+                else type(algo).__name__
+            )
+            with trace(f"train.algorithm.{label}"):
+                model = algo.train(ctx, pd)
             if not skip_sanity_check:
                 run_sanity_check(model)
             models.append(model)
@@ -274,15 +289,22 @@ class Engine:
         """Evaluate one EngineParams: per fold, train then batch-predict all
         algorithms, group per query, and serve.  Returns
         [(eval_info, [(query, served_prediction, actual)])]."""
+        from predictionio_tpu.obs.tracing import trace
+
         ds, prep, algos, serving = self.instantiate(params)
-        eval_sets = ds.read_eval(ctx)
+        with trace("eval.datasource.read_eval"):
+            eval_sets = ds.read_eval(ctx)
         results = []
         for td, eval_info, qa_pairs in eval_sets:
-            pd = prep.prepare(ctx, td)
-            models = [a.train(ctx, pd) for a in algos]
-            results.append(
-                (eval_info, serve_eval_fold(algos, models, serving, qa_pairs))
-            )
+            with trace("eval.fold"):
+                pd = prep.prepare(ctx, td)
+                models = [a.train(ctx, pd) for a in algos]
+                results.append(
+                    (
+                        eval_info,
+                        serve_eval_fold(algos, models, serving, qa_pairs),
+                    )
+                )
         return results
 
 

@@ -24,6 +24,8 @@ from predictionio_tpu.core.engine import Engine, EngineParams
 from predictionio_tpu.core.persistence import save_models
 from predictionio_tpu.data.storage.base import EngineInstance, EvaluationInstance
 from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
+from predictionio_tpu.obs.metrics import REGISTRY
+from predictionio_tpu.obs.tracing import install_jax_compile_listener, trace
 
 log = logging.getLogger("predictionio_tpu.workflow")
 
@@ -41,6 +43,29 @@ class WorkflowParams:
 
 def _now() -> datetime:
     return datetime.now(tz=timezone.utc)
+
+
+def _compile_seconds() -> float:
+    """Total XLA compile seconds recorded so far (jax.monitoring listener)."""
+    fam = REGISTRY.get("pio_jax_compile_seconds")
+    if fam is None:
+        return 0.0
+    return sum(child.sum for _, child in fam.series())
+
+
+def _stage_breakdown(root, compile_delta_s: float | None = None) -> dict:
+    """Per-stage seconds from the run's span tree + the compile split.
+
+    ``compile_delta_s`` is the growth of ``pio_jax_compile_seconds`` over
+    this run — stage wall time minus it approximates pure execute time.
+    """
+    out = {
+        name: round(secs, 4) for name, secs in root.breakdown().items()
+    }
+    out["total"] = round(root.duration_s, 4)
+    if compile_delta_s is not None:
+        out["jax_compile"] = round(compile_delta_s, 4)
+    return out
 
 
 def run_train(
@@ -79,42 +104,58 @@ def run_train(
         **engine_params.to_json_fields(),
     )
     instances.insert(instance)
+    # compile-vs-execute split: XLA compile durations land in
+    # pio_jax_compile_seconds alongside the stage spans
+    install_jax_compile_listener()
+    compile_s0 = _compile_seconds()
     try:
-        algos, models = engine.train_full(
-            ctx,
-            engine_params,
-            skip_sanity_check=wp.skip_sanity_check,
-            stop_after_read=wp.stop_after_read,
-            stop_after_prepare=wp.stop_after_prepare,
-        )
-        if wp.stop_after_read or wp.stop_after_prepare:
-            log.info("training stopped early by workflow params")
-            instances.delete(instance.id)
-            return None
-        persistable = engine.make_persistent_models(
-            ctx, engine_params, models, algos=algos
-        )
-        # PersistentModel flavors save themselves; only a manifest is stored
-        # (Engine.makeSerializableModels:284 + PersistentModelManifest)
-        from predictionio_tpu.core.persistent_model import (
-            PersistentModel,
-            PersistentModelManifest,
-        )
+        with trace("workflow.run_train") as root:
+            algos, models = engine.train_full(
+                ctx,
+                engine_params,
+                skip_sanity_check=wp.skip_sanity_check,
+                stop_after_read=wp.stop_after_read,
+                stop_after_prepare=wp.stop_after_prepare,
+            )
+            if wp.stop_after_read or wp.stop_after_prepare:
+                log.info("training stopped early by workflow params")
+                instances.delete(instance.id)
+                return None
+            persistable = engine.make_persistent_models(
+                ctx, engine_params, models, algos=algos
+            )
+            # PersistentModel flavors save themselves; only a manifest is
+            # stored (Engine.makeSerializableModels:284 +
+            # PersistentModelManifest)
+            from predictionio_tpu.core.persistent_model import (
+                PersistentModel,
+                PersistentModelManifest,
+            )
 
-        stored = []
-        for a, m in zip(algos, persistable):
-            if isinstance(m, PersistentModel) and m.save(
-                instance.id, getattr(a, "params", None)
-            ):
-                stored.append(PersistentModelManifest(type(m).class_path()))
-            else:
-                stored.append(m)
-        # sharded save: big array leaves (NCF tables, ALS factors) become
-        # individual parts instead of one monolithic pickle blob
-        save_models(storage.models(), instance.id, stored)
+            stored = []
+            for a, m in zip(algos, persistable):
+                if isinstance(m, PersistentModel) and m.save(
+                    instance.id, getattr(a, "params", None)
+                ):
+                    stored.append(
+                        PersistentModelManifest(type(m).class_path())
+                    )
+                else:
+                    stored.append(m)
+            # sharded save: big array leaves (NCF tables, ALS factors)
+            # become individual parts instead of one monolithic pickle blob
+            with trace("train.persist.save_models"):
+                save_models(storage.models(), instance.id, stored)
         done = instance.completed()
         instances.update(done)
         log.info("training finished: engine instance %s", instance.id)
+        log.info(
+            "DASE stage breakdown: %s",
+            json.dumps(
+                _stage_breakdown(root, _compile_seconds() - compile_s0),
+                sort_keys=True,
+            ),
+        )
         return done
     except Exception:
         import dataclasses as _dc
@@ -203,7 +244,8 @@ def run_evaluation(
     try:
         if not isinstance(evaluator, MetricEvaluator):
             evaluator = MetricEvaluator(evaluator)
-        result = evaluator.evaluate(ctx, engine, engine_params_list)
+        with trace("workflow.run_evaluation"):
+            result = evaluator.evaluate(ctx, engine, engine_params_list)
         import dataclasses as _dc
 
         instances.update(

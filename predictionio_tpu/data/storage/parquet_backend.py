@@ -377,7 +377,18 @@ class ParquetEventStore:
         files = sorted(shard_dir.glob("seg-*.parquet"))
         if not files:
             return None
-        t = pa.concat_tables([pq.read_table(f) for f in files])
+        # ParquetFile.read, NOT pq.read_table: read_table routes through the
+        # dataset API, which hive-infers a `shard` partition column from the
+        # shard=<k>/ path — compact would then materialize that column into
+        # the rewritten segment, and the next read_table would see the
+        # physical int32 column clash with its own inferred dictionary one
+        tables = []
+        for f in files:
+            ft = pq.ParquetFile(f).read()
+            if "shard" in ft.column_names:  # stray column from old compacts
+                ft = ft.drop(["shard"])
+            tables.append(ft)
+        t = pa.concat_tables(tables)
         if pre_filter is not None:
             t = t.filter(pre_filter)
         if not t.num_rows:

@@ -107,16 +107,23 @@ class MetricEvaluator:
         engine: Engine,
         engine_params_list: Sequence[EngineParams],
     ) -> EvaluationResult:
+        from predictionio_tpu.obs.tracing import trace
+
         if not engine_params_list:
             raise ValueError("engine_params_list must not be empty")
         records: list[EvaluationRecord] = []
         best_idx = 0
         for i, ep in enumerate(engine_params_list):
-            fold_data = engine.eval(ctx, ep)
-            score = self.metric.calculate(fold_data)
-            others = {
-                m.header(): m.calculate(fold_data) for m in self.other_metrics
-            }
+            # one span per params candidate: a sweep's cost decomposes into
+            # engine.eval (train+predict per fold) vs metric calculation
+            with trace("eval.engine_params"):
+                fold_data = engine.eval(ctx, ep)
+            with trace("eval.metric.calculate"):
+                score = self.metric.calculate(fold_data)
+                others = {
+                    m.header(): m.calculate(fold_data)
+                    for m in self.other_metrics
+                }
             records.append(EvaluationRecord(ep, score, others))
             log.info(
                 "eval %d/%d: %s = %s",

@@ -1,0 +1,48 @@
+"""Observability: metrics registry, per-stage tracing, exposition.
+
+The subsystem every later perf PR leans on — counters/gauges/log-bucketed
+histograms (metrics.py), context-manager spans with a recent-trace ring
+(tracing.py), Prometheus + JSON HTTP exposition (http.py), and a sniffer
+plugin proving the plugin seams can consume the registry (plugin.py).
+Dependency-free; the process-global default registry is ``REGISTRY``.
+"""
+
+from predictionio_tpu.obs.metrics import (
+    LATENCY_BUCKETS,
+    REGISTRY,
+    SIZE_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    quantile_from_buckets,
+)
+from predictionio_tpu.obs.tracing import (
+    Span,
+    clear_traces,
+    current_span,
+    install_jax_compile_listener,
+    observe_span,
+    recent_traces,
+    trace,
+)
+
+__all__ = [
+    "LATENCY_BUCKETS",
+    "REGISTRY",
+    "SIZE_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Span",
+    "clear_traces",
+    "current_span",
+    "default_registry",
+    "install_jax_compile_listener",
+    "observe_span",
+    "quantile_from_buckets",
+    "recent_traces",
+    "trace",
+]

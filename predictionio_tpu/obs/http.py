@@ -1,0 +1,55 @@
+"""Metrics exposition routes for any :class:`HTTPApp`.
+
+``add_metrics_routes(app)`` wires the standard three endpoints onto a server:
+
+  GET /metrics        Prometheus text format 0.0.4
+  GET /metrics.json   the JSON shape (adds p50/p95/p99 per histogram series)
+  GET /traces.json    recent finished root spans (ring buffer)
+
+Every server (prediction :8000, event :7070, admin :7071, dashboard :9000)
+calls this so one scrape config covers the fleet.  Apps constructed with an
+``access_key`` gate these routes like everything else on that app.
+"""
+
+from __future__ import annotations
+
+from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from predictionio_tpu.obs.tracing import recent_traces
+
+#: Prometheus text exposition content type.
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def add_metrics_routes(app, registry: MetricsRegistry | None = None):
+    """Register /metrics, /metrics.json, and /traces.json on ``app``."""
+    from predictionio_tpu.server.httpd import (
+        Request,
+        Response,
+        json_response,
+    )
+
+    reg = registry or REGISTRY
+
+    @app.route("GET", "/metrics")
+    def metrics(req: Request) -> Response:
+        return Response(
+            200,
+            reg.render_prometheus(),
+            content_type=PROMETHEUS_CONTENT_TYPE,
+        )
+
+    @app.route("GET", "/metrics\\.json")
+    def metrics_json(req: Request) -> Response:
+        return json_response(200, reg.render_json())
+
+    @app.route("GET", "/traces\\.json")
+    def traces_json(req: Request) -> Response:
+        try:
+            limit = int(req.query.get("limit", 20))
+        except ValueError:
+            return json_response(400, {"message": "limit must be an integer"})
+        return json_response(
+            200, {"traces": recent_traces(min(max(limit, 0), 256))}
+        )
+
+    return app

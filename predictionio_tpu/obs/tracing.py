@@ -1,0 +1,184 @@
+"""Lightweight per-stage spans feeding the metrics registry.
+
+``trace("stage")`` is a context manager that times its block, records the
+duration into the ``pio_span_seconds{span="stage"}`` histogram, and builds a
+parent/child tree through a thread-local span stack — nested ``trace`` blocks
+become children of the enclosing one.  Finished ROOT spans additionally land
+in a bounded ring buffer (:func:`recent_traces`) so "what did the last train
+run spend its time on" is answerable without a metrics backend.
+
+This is deliberately not OpenTelemetry: no IDs, no export, no sampling — a
+span is a (name, duration, children) record and one histogram observation.
+The serving hot path uses the registry directly (a span allocation per query
+would be measurable); spans are for the second-scale stages: DASE train
+stages, JAX compiles, batch predict, eval folds.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any
+
+from predictionio_tpu.obs.metrics import (
+    REGISTRY,
+    STAGE_BUCKETS,
+    MetricsRegistry,
+)
+
+_tls = threading.local()
+
+#: ring of the most recent finished root spans (as dicts), newest last
+_ring: deque[dict[str, Any]] = deque(maxlen=256)
+_ring_lock = threading.Lock()
+
+
+class Span:
+    """One timed block.  ``duration_s`` is valid after the block exits."""
+
+    __slots__ = ("name", "start_s", "duration_s", "children", "error")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.start_s = 0.0
+        self.duration_s = 0.0
+        self.children: list[Span] = []
+        self.error: str | None = None
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "name": self.name,
+            "duration_s": round(self.duration_s, 9),
+        }
+        if self.error:
+            d["error"] = self.error
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+    def breakdown(self) -> dict[str, float]:
+        """Flat child-name → seconds map (duplicate names accumulate)."""
+        out: dict[str, float] = {}
+        for c in self.children:
+            out[c.name] = out.get(c.name, 0.0) + c.duration_s
+        return out
+
+
+class trace:
+    """Context manager: ``with trace("train.prepare") as span: ...``"""
+
+    __slots__ = ("span", "_registry", "_record")
+
+    def __init__(
+        self,
+        name: str,
+        registry: MetricsRegistry | None = None,
+        record: bool = True,
+    ):
+        self.span = Span(name)
+        self._registry = registry or REGISTRY
+        self._record = record
+
+    def __enter__(self) -> Span:
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self.span)
+        self.span.start_s = time.perf_counter()
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.span.duration_s = time.perf_counter() - self.span.start_s
+        if exc is not None:
+            self.span.error = f"{type(exc).__name__}: {exc}"
+        stack = _tls.stack
+        stack.pop()
+        if stack:
+            stack[-1].children.append(self.span)
+        else:
+            with _ring_lock:
+                _ring.append(self.span.to_dict())
+        if self._record:
+            self._registry.histogram(
+                "pio_span_seconds",
+                "Duration of named stages (trace spans)",
+                labelnames=("span",),
+                buckets=STAGE_BUCKETS,
+            ).labels(self.span.name).observe(self.span.duration_s)
+        return None
+
+
+def current_span() -> Span | None:
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+def observe_span(
+    name: str, seconds: float, registry: MetricsRegistry | None = None
+) -> None:
+    """Record an externally-timed duration as if it were a span (used by the
+    JAX compile-time listener, which reports durations, not blocks)."""
+    (registry or REGISTRY).histogram(
+        "pio_span_seconds",
+        "Duration of named stages (trace spans)",
+        labelnames=("span",),
+        buckets=STAGE_BUCKETS,
+    ).labels(name).observe(seconds)
+
+
+def recent_traces(n: int = 20) -> list[dict[str, Any]]:
+    """The most recent finished root spans, newest first."""
+    with _ring_lock:
+        items = list(_ring)
+    return items[::-1][:n]
+
+
+def clear_traces() -> None:
+    with _ring_lock:
+        _ring.clear()
+
+
+_jax_listener_installed = False
+_jax_listener_lock = threading.Lock()
+
+
+def install_jax_compile_listener() -> bool:
+    """Forward JAX compilation-event durations into the registry.
+
+    Registers a ``jax.monitoring`` duration listener that records
+    ``/jax/core/compile``-family events into ``pio_jax_compile_seconds`` —
+    this is how a training run's stage breakdown separates XLA compile time
+    from execute time.  Idempotent; returns False when the monitoring API is
+    unavailable (the listener is additive-only, so failure is harmless).
+    """
+    global _jax_listener_installed
+    with _jax_listener_lock:
+        if _jax_listener_installed:
+            return True
+        try:
+            from jax import monitoring
+        except Exception:
+            return False
+        if not hasattr(monitoring, "register_event_duration_secs_listener"):
+            return False
+
+        def _on_duration(event: str, duration: float, **kwargs) -> None:
+            if "compile" not in event:
+                return
+            try:
+                REGISTRY.histogram(
+                    "pio_jax_compile_seconds",
+                    "XLA compile time by jax monitoring event",
+                    labelnames=("event",),
+                    buckets=STAGE_BUCKETS,
+                ).labels(event).observe(duration)
+            except Exception:
+                pass  # telemetry must never break compilation
+
+        try:
+            monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception:
+            return False
+        _jax_listener_installed = True
+        return True

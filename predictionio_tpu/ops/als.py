@@ -83,7 +83,10 @@ def _pvary(x, axis):
     pcast = getattr(jax.lax, "pcast", None)
     if pcast is not None:
         return pcast(x, (axis,), to="varying")
-    return jax.lax.pvary(x, (axis,))  # pre-pcast jax versions
+    pvary = getattr(jax.lax, "pvary", None)
+    if pvary is not None:  # pre-pcast jax versions
+        return pvary(x, (axis,))
+    return x  # jax <= 0.4: no vma tracking, nothing to mark
 
 
 def _segment_stats(
@@ -243,7 +246,12 @@ def _half_step(
     if axis:
         # one psum over the flat stats (A | b | counts packed together)
         acc = jax.lax.psum(acc, axis)
-        n_dev = jax.lax.axis_size(axis)
+        # axis_size is post-0.4 API; psum of 1 folds to the same constant
+        n_dev = (
+            jax.lax.axis_size(axis)
+            if hasattr(jax.lax, "axis_size")
+            else jax.lax.psum(1, axis)
+        )
         slice_size = num_seg_pad // n_dev
         start = jax.lax.axis_index(axis) * slice_size
         acc = jax.lax.dynamic_slice_in_dim(acc, start, slice_size)
@@ -636,17 +644,19 @@ def _make_train_step(mesh: Mesh | None, num_users_pad, num_items_pad, p: ALSPara
     if mesh is None:
         fn = jax.jit(step)
     else:
+        from predictionio_tpu.parallel.mesh import shard_map_compat
+
         coo_spec = PSpec("data")
         repl = PSpec(None, None)
-        # check_vma=False: outputs are all_gather'ed, hence replicated in
-        # value, but the static vma analysis cannot prove it.
+        # check=False: outputs are all_gather'ed, hence replicated in
+        # value, but the static vma/rep analysis cannot prove it.
         fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=mesh,
                 in_specs=(coo_spec, coo_spec, coo_spec, coo_spec, repl, repl),
                 out_specs=(repl, repl),
-                check_vma=False,
+                check=False,
             )
         )
     _STEP_CACHE[key] = fn
@@ -657,14 +667,17 @@ def _init_factors(p: ALSParams, num_users_pad, num_items_pad, num_users, num_ite
     """MLlib-style nonnegative init (abs of gaussians, scaled): keeps initial
     scores O(1) and positive, which conditions ALS well on rating data.
     Padded rows are zeroed so the implicit-feedback Gram (Y^T Y) sees only
-    real entities.  Seed-deterministic, so every process of a multi-host
-    run computes identical replicas."""
+    real entities.  Seed-deterministic AND mesh-independent: the gaussians
+    are drawn for the REAL entity counts and zero-padded to the mesh lane,
+    so a single-device run and an 8-device mesh start from identical
+    factors (mesh-vs-single parity) and every process of a multi-host run
+    computes identical replicas."""
     key = jax.random.PRNGKey(p.seed)
     ku, kv = jax.random.split(key)
-    U0 = jnp.abs(jax.random.normal(ku, (num_users_pad, p.rank), dtype)) / math.sqrt(p.rank)
-    V0 = jnp.abs(jax.random.normal(kv, (num_items_pad, p.rank), dtype)) / math.sqrt(p.rank)
-    U0 = U0.at[num_users:].set(0.0)
-    V0 = V0.at[num_items:].set(0.0)
+    U0 = jnp.abs(jax.random.normal(ku, (num_users, p.rank), dtype)) / math.sqrt(p.rank)
+    V0 = jnp.abs(jax.random.normal(kv, (num_items, p.rank), dtype)) / math.sqrt(p.rank)
+    U0 = jnp.pad(U0, ((0, num_users_pad - num_users), (0, 0)))
+    V0 = jnp.pad(V0, ((0, num_items_pad - num_items), (0, 0)))
     return U0, V0
 
 

@@ -43,6 +43,34 @@ class MeshConfig:
         return cls(axes=dict(d["axes"]))
 
 
+def shard_map_compat(f, mesh: Mesh, in_specs, out_specs, check: bool = True):
+    """``shard_map`` across the JAX API migration.
+
+    Newer releases expose top-level ``jax.shard_map(..., check_vma=)``;
+    0.4.x has ``jax.experimental.shard_map.shard_map(..., check_rep=)``.
+    ``check=False`` disables the replication/vma static check under either
+    spelling (needed when outputs are all_gather'ed to replicated values the
+    analysis cannot prove).
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        try:
+            return sm(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=check,
+            )
+        except TypeError:  # jax ~0.6: top-level but still check_rep
+            return sm(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=check,
+            )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check
+    )
+
+
 def make_mesh(
     config: MeshConfig | None = None, devices: Sequence[jax.Device] | None = None
 ) -> Mesh:

@@ -13,6 +13,7 @@ Route parity with tools/admin/AdminAPI.scala:45-109 + CommandClient.scala:61:
 from __future__ import annotations
 
 from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
+from predictionio_tpu.obs.http import add_metrics_routes
 from predictionio_tpu.server.httpd import (
     AppServer,
     HTTPApp,
@@ -39,6 +40,7 @@ def create_admin_app(
     applied to the admin surface); TLS comes from the AppServer layer."""
     storage = storage or get_storage()
     app = HTTPApp("adminserver", access_key=access_key)
+    add_metrics_routes(app)
 
     def describe(d: AppDescription) -> dict:
         return d.to_json_dict()

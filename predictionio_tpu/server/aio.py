@@ -21,9 +21,11 @@ import logging
 import os
 import ssl as ssl_mod
 import threading
+import time
 from typing import Any
 from urllib.parse import parse_qs, urlsplit
 
+from predictionio_tpu.obs.metrics import REGISTRY
 from predictionio_tpu.server.httpd import (
     HTTPApp,
     Request,
@@ -37,10 +39,35 @@ log = logging.getLogger("predictionio_tpu.aio")
 _MAX_HEADER_BYTES = 64 * 1024
 _MAX_BODY_BYTES = 64 * 1024 * 1024
 
+#: whole-server request timing (handler + executor hop), coarse labels only —
+#: per-route latency belongs to the app's own pio_request_latency_seconds
+_m_http = REGISTRY.histogram(
+    "pio_http_request_seconds",
+    "Async front-end request handling time by server/method/status",
+    labelnames=("server", "method", "status"),
+)
+
+#: label-cardinality guard: the method token is client-controlled (any word
+#: parses), so unknown verbs collapse to OTHER instead of minting unbounded
+#: histogram children in the process-global registry
+_KNOWN_METHODS = frozenset(
+    ("GET", "POST", "PUT", "DELETE", "HEAD", "OPTIONS", "PATCH")
+)
+
 
 async def _handle_app_request(app: HTTPApp, req: Request) -> Response:
     """Route like HTTPApp.handle, awaiting coroutine handlers and pushing
     sync handlers to the executor."""
+    t0 = time.perf_counter()
+    resp = await _route_app_request(app, req)
+    method = req.method if req.method in _KNOWN_METHODS else "OTHER"
+    _m_http.labels(app.name, method, str(resp.status)).observe(
+        time.perf_counter() - t0
+    )
+    return resp
+
+
+async def _route_app_request(app: HTTPApp, req: Request) -> Response:
     path_matched = False
     for method, pattern, fn in app._routes:
         m = pattern.match(req.path)

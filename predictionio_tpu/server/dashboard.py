@@ -12,6 +12,8 @@ from __future__ import annotations
 import html
 
 from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
+from predictionio_tpu.obs.http import add_metrics_routes
+from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
 from predictionio_tpu.server.httpd import (
     AppServer,
     HTTPApp,
@@ -21,6 +23,34 @@ from predictionio_tpu.server.httpd import (
 )
 
 
+def _metrics_table_html(registry: MetricsRegistry) -> str:
+    """The registry as an HTML table: counters/gauges with their value,
+    histograms with count + p50/p95/p99 (computed from the log buckets)."""
+    rows = []
+    for name, fam in sorted(registry.render_json().items()):
+        for s in fam["series"]:
+            labels = ",".join(f"{k}={v}" for k, v in s["labels"].items())
+            if fam["type"] in ("counter", "gauge"):
+                detail = f"{s['value']:g}"
+            else:
+                detail = (
+                    f"n={s['count']} p50={s['p50']:.6f} "
+                    f"p95={s['p95']:.6f} p99={s['p99']:.6f}"
+                )
+            rows.append(
+                f"<tr><td>{html.escape(name)}</td>"
+                f"<td>{html.escape(labels)}</td>"
+                f"<td>{html.escape(fam['type'])}</td>"
+                f"<td>{html.escape(detail)}</td></tr>"
+            )
+    return (
+        "<h2>Metrics</h2><table border='1'>"
+        "<tr><th>metric</th><th>labels</th><th>type</th><th>value</th></tr>"
+        + "".join(rows)
+        + "</table>"
+    )
+
+
 def create_dashboard_app(
     storage: StorageRuntime | None = None, access_key: str | None = None
 ) -> HTTPApp:
@@ -28,6 +58,7 @@ def create_dashboard_app(
     KeyAuthentication); TLS comes from the AppServer layer below."""
     storage = storage or get_storage()
     app = HTTPApp("dashboard", access_key=access_key)
+    add_metrics_routes(app)
 
     @app.route("GET", "/")
     def index(req: Request) -> Response:
@@ -47,7 +78,7 @@ def create_dashboard_app(
             "<h1>Completed evaluations</h1>"
             "<table border='1'><tr><th>id</th><th>evaluation</th>"
             f"<th>started</th><th>finished</th><th>result</th></tr>{rows}"
-            "</table></body></html>",
+            f"</table>{_metrics_table_html(REGISTRY)}</body></html>",
         )
 
     @app.route("GET", "/engine_instances/(?P<iid>[^/]+)")

@@ -36,6 +36,8 @@ from predictionio_tpu.data.webhooks import (
     to_event,
 )
 from predictionio_tpu.data.datamap import parse_event_time
+from predictionio_tpu.obs.http import add_metrics_routes
+from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
 from predictionio_tpu.server.httpd import (
     AppServer,
     HTTPApp,
@@ -93,6 +95,7 @@ def create_event_server_app(
     storage: StorageRuntime | None = None,
     stats: bool = False,
     plugins: "PluginContext | None" = None,
+    registry: MetricsRegistry | None = None,
 ) -> HTTPApp:
     from predictionio_tpu.server.plugins import PluginContext
 
@@ -101,6 +104,15 @@ def create_event_server_app(
     hourly = HourlyStats() if stats else None
     levents = storage.l_events()
     plugins = plugins or PluginContext.from_env()
+    registry = registry or REGISTRY
+    # /metrics + /metrics.json: unauthenticated like GET / — scrapers
+    # carry no per-app access keys, and the registry holds no event payloads
+    add_metrics_routes(app, registry)
+    m_ingested = registry.counter(
+        "pio_events_ingested_total",
+        "Events accepted by the event server, by event name",
+        labelnames=("event",),
+    )
 
     def authed(handler):
         def wrapped(req: Request) -> Response:
@@ -112,7 +124,20 @@ def create_event_server_app(
 
         return wrapped
 
+    # label-cardinality guard: event names are client-supplied (some apps
+    # embed ids in them) and registry children are never evicted — past the
+    # cap, new names collapse into one overflow series
+    seen_event_labels: set[str] = set()
+    _MAX_EVENT_LABELS = 100
+
     def bookkeep(auth: AuthData, status: int, event: Event) -> None:
+        name = event.event
+        if name not in seen_event_labels:
+            if len(seen_event_labels) >= _MAX_EVENT_LABELS:
+                name = "_other"
+            else:
+                seen_event_labels.add(name)
+        m_ingested.labels(name).inc()
         if hourly is not None:
             hourly.update(
                 auth.app_id,

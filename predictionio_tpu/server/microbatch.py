@@ -25,6 +25,12 @@ import time
 from collections import deque
 from typing import Any, Callable, Sequence
 
+from predictionio_tpu.obs.metrics import (
+    REGISTRY,
+    MetricsRegistry,
+    SIZE_BUCKETS,
+)
+
 
 class MicroBatcher:
     """Coalesce ``submit``-ed items into batched ``batch_fn`` calls.
@@ -34,22 +40,54 @@ class MicroBatcher:
     default executor): sync route handlers doing storage I/O share the
     default pool, and a queue-full default pool would delay dispatch waves
     under mixed load — tail latency, not throughput.
+
+    Per-wave telemetry lands in ``registry`` (default: the process
+    registry): queue depth, batch size, and the queue-wait vs device-time
+    split that decomposes a query's latency into "waiting behind the
+    in-flight wave" vs "inside batch_fn on the device".
     """
 
     def __init__(
         self,
         batch_fn: Callable[[Sequence[Any]], Sequence[Any]],
         max_batch: int = 64,
+        drain_timeout_s: float = 5.0,
+        registry: MetricsRegistry | None = None,
     ):
         self.batch_fn = batch_fn
         self.max_batch = max_batch
-        self._pending: deque[tuple[Any, asyncio.Future]] = deque()
+        #: how long close() waits for the in-flight wave before abandoning
+        #: the daemon worker (was a hard-coded 5.0 s deadline)
+        self.drain_timeout_s = drain_timeout_s
+        self._pending: deque[tuple[Any, asyncio.Future, float]] = deque()
         self._cond = threading.Condition()
         self._worker: threading.Thread | None = None
         self._in_wave = False
         self._closed = False
         #: wave-size histogram for the status page ({batch_size: count})
         self.wave_sizes: dict[int, int] = {}
+        reg = registry or REGISTRY
+        self._m_queue_depth = reg.gauge(
+            "pio_microbatch_queue_depth",
+            "Queries queued behind the in-flight wave",
+        )
+        self._m_batch_size = reg.histogram(
+            "pio_microbatch_batch_size",
+            "Queries coalesced per dispatch wave",
+            buckets=SIZE_BUCKETS,
+        )
+        self._m_queue_wait = reg.histogram(
+            "pio_microbatch_queue_wait_seconds",
+            "Per-query wait from submit to wave dispatch",
+        )
+        self._m_device_time = reg.histogram(
+            "pio_microbatch_device_seconds",
+            "Per-wave batch_fn (device dispatch) duration",
+        )
+        self._m_drain_timeout = reg.counter(
+            "pio_microbatch_drain_timeout_total",
+            "close() deadlines expired with a wave still in flight",
+        )
 
     async def submit(self, item: Any) -> Any:
         loop = asyncio.get_running_loop()
@@ -57,7 +95,8 @@ class MicroBatcher:
         with self._cond:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
-            self._pending.append((item, fut))
+            self._pending.append((item, fut, time.perf_counter()))
+            self._m_queue_depth.set(len(self._pending))
             if self._worker is None:
                 self._worker = threading.Thread(
                     target=self._drain, name="microbatch", daemon=True
@@ -78,19 +117,20 @@ class MicroBatcher:
             self._pending.clear()
             self._cond.notify_all()
         err = RuntimeError("MicroBatcher closed during shutdown")
-        for _, fut in dropped:
+        for _, fut, _t in dropped:
             try:
                 fut.get_loop().call_soon_threadsafe(_fail_if_pending, fut, err)
             except RuntimeError:
                 # the futures' loop is already closed (server tore the
                 # loop down first) — nothing can await them anymore
                 pass
-        deadline = time.monotonic() + 5.0
+        deadline = time.monotonic() + self.drain_timeout_s
         while time.monotonic() < deadline:
             with self._cond:
                 if not self._in_wave:
                     return
             time.sleep(0.01)
+        self._m_drain_timeout.inc()
 
     def _drain(self) -> None:
         """Persistent worker loop: sleep on the condition until work (or
@@ -106,13 +146,19 @@ class MicroBatcher:
                     for _ in range(min(len(self._pending), self.max_batch))
                 ]
                 self._in_wave = True
-            items = [it for it, _ in wave]
-            futures = [f for _, f in wave]
+                self._m_queue_depth.set(len(self._pending))
+            t_dispatch = time.perf_counter()
+            items = [it for it, _, _ in wave]
+            futures = [f for _, f, _ in wave]
+            self._m_batch_size.observe(len(items))
+            for _, _, t_enq in wave:
+                self._m_queue_wait.observe(t_dispatch - t_enq)
             # all futures in a wave come from submit() calls on the same
             # server loop; resolve with ONE loop wakeup
             loop = futures[0].get_loop()
             try:
                 results = self.batch_fn(items)
+                self._m_device_time.observe(time.perf_counter() - t_dispatch)
                 if len(results) != len(items):
                     raise RuntimeError(
                         f"batch_fn returned {len(results)} results "

@@ -37,6 +37,8 @@ from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage.base import EngineInstance
 from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
+from predictionio_tpu.obs.http import add_metrics_routes
+from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
 from predictionio_tpu.server.httpd import (
     AppServer,
     HTTPApp,
@@ -194,6 +196,8 @@ def create_prediction_server_app(
     #: waves above ~32 lengthen the tail (a query waits up to two waves);
     #: measured on the serving bench, 32 minimizes concurrent p99
     max_batch: int = 32,
+    drain_timeout_s: float = 5.0,
+    registry: MetricsRegistry | None = None,
 ) -> HTTPApp:
     from predictionio_tpu.server.plugins import PluginContext
 
@@ -203,6 +207,18 @@ def create_prediction_server_app(
     stats = {"request_count": 0, "avg_serving_sec": 0.0, "last_serving_sec": 0.0}
     stats_lock = threading.Lock()
     started_at = datetime.now(tz=timezone.utc)
+    registry = registry or REGISTRY
+    add_metrics_routes(app, registry)
+    m_latency = registry.histogram(
+        "pio_request_latency_seconds",
+        "Serving request latency by route and status",
+        labelnames=("route", "status"),
+    )
+
+    def _observe(route: str, status: int, t0: float) -> float:
+        dt = time.perf_counter() - t0
+        m_latency.labels(route, str(status)).observe(dt)
+        return dt
 
     if feedback.enabled and feedback.app_id is None:
         if not feedback.access_key:
@@ -285,7 +301,7 @@ def create_prediction_server_app(
                 _feedback_event(query, rendered)
             except Exception as e:  # feedback must never fail the query
                 log.error("feedback event failed: %s", e)
-        dt = time.perf_counter() - t0
+        dt = _observe("/queries.json", 200, t0)
         with stats_lock:
             n = stats["request_count"]
             stats["avg_serving_sec"] = (stats["avg_serving_sec"] * n + dt) / (n + 1)
@@ -358,11 +374,16 @@ def create_prediction_server_app(
                     out[i] = ("err", e)
             return out
 
-        batcher = MicroBatcher(_serve_wave, max_batch=max_batch)
+        batcher = MicroBatcher(
+            _serve_wave,
+            max_batch=max_batch,
+            drain_timeout_s=drain_timeout_s,
+            registry=registry,
+        )
         app.microbatcher = batcher  # exposed for tests/status introspection
 
         def _bump_stats(t0: float) -> None:
-            dt = time.perf_counter() - t0
+            dt = _observe("/queries.json", 200, t0)
             with stats_lock:
                 n = stats["request_count"]
                 stats["avg_serving_sec"] = (
@@ -379,16 +400,20 @@ def create_prediction_server_app(
                 if not isinstance(payload, dict):
                     raise ValueError("query must be a JSON object")
             except Exception as e:
+                _observe("/queries.json", 400, t0)
                 return error_response(400, f"invalid query: {e}")
             try:
                 status, value = await batcher.submit(payload)
             except Exception as e:
                 log.exception("query serving failed")
+                _observe("/queries.json", 500, t0)
                 return error_response(500, f"{type(e).__name__}: {e}")
             if status == "bad":
+                _observe("/queries.json", 400, t0)
                 return error_response(400, f"invalid query: {value}")
             if status == "err":
                 log.error("query serving failed: %s", value)
+                _observe("/queries.json", 500, t0)
                 return error_response(
                     500, f"{type(value).__name__}: {value}"
                 )
@@ -403,11 +428,13 @@ def create_prediction_server_app(
             try:
                 payload, query = _parse_query(req)
             except Exception as e:
+                _observe("/queries.json", 400, t0)
                 return error_response(400, f"invalid query: {e}")
             try:
                 query, prediction = deployed.predict(query)
             except Exception as e:
                 log.exception("query serving failed")
+                _observe("/queries.json", 500, t0)
                 return error_response(500, f"{type(e).__name__}: {e}")
             return _finish_query(payload, query, prediction, t0)
 
@@ -544,6 +571,7 @@ def create_prediction_server(
     feedback: FeedbackConfig | None = None,
     access_key: str | None = None,
     server_kind: str = "aio",
+    registry: MetricsRegistry | None = None,
 ):
     """Build the deploy server.
 
@@ -574,6 +602,7 @@ def create_prediction_server(
         on_stop=on_stop,
         access_key=access_key,
         use_microbatch=server_kind == "aio",
+        registry=registry,
     )
     if server_kind == "aio":
         from predictionio_tpu.server.aio import AsyncAppServer

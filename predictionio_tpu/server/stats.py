@@ -10,7 +10,7 @@ from __future__ import annotations
 import threading
 from collections import Counter
 from dataclasses import dataclass, field
-from datetime import datetime, timezone
+from datetime import datetime, timedelta, timezone
 from typing import Any
 
 
@@ -76,8 +76,19 @@ class HourlyStats:
             now = _now()
             hour = _hour_floor(now)
             if hour > self.current.start_time:
-                self.current.end_time = hour
-                self.previous = self.current
+                # the frozen window covers exactly its own hour, not the
+                # whole idle gap
+                self.current.end_time = self.current.start_time + timedelta(
+                    hours=1
+                )
+                # only an ADJACENT window is "the previous hour"; after a
+                # multi-hour idle gap the prior hour had no traffic, so a
+                # stale window must not be served as previousHour
+                self.previous = (
+                    self.current
+                    if hour - self.current.start_time == timedelta(hours=1)
+                    else None
+                )
                 self.current = StatsWindow(start_time=hour)
             self.current.ete_count[
                 (app_id, entity_type, target_entity_type, event_name)

@@ -538,6 +538,32 @@ def do_template(args) -> int:
     return 0
 
 
+def do_metrics(args) -> int:
+    """`pio metrics`: dump the observability registry.
+
+    With ``--url``, scrapes a running server's exposition endpoint
+    (``/metrics`` or ``/metrics.json``); without it, dumps this process's
+    registry — useful at the end of in-process runs (`pio train` emits the
+    DASE stage histograms, `pio eval` the fold spans).
+    """
+    from predictionio_tpu.obs.metrics import REGISTRY
+
+    if args.url:
+        import urllib.request
+
+        path = "/metrics.json" if args.json else "/metrics"
+        url = args.url.rstrip("/") + path
+        with urllib.request.urlopen(url, timeout=10) as r:
+            body = r.read().decode("utf-8")
+        print(body if not args.json else json.dumps(json.loads(body), indent=2))
+        return 0
+    if args.json:
+        _print(REGISTRY.render_json())
+    else:
+        print(REGISTRY.render_prometheus(), end="")
+    return 0
+
+
 def do_build(args) -> int:
     """`pio build` parity: engines are plain Python — nothing to compile.
     Validates the engine.json instead (the useful part of the verb)."""
@@ -727,6 +753,16 @@ def build_parser() -> argparse.ArgumentParser:
     tp.add_argument("directory", nargs="?")
     tp.set_defaults(fn=do_template)
 
+    mt = sub.add_parser("metrics")
+    mt.add_argument(
+        "--url", help="scrape a running server (e.g. http://127.0.0.1:8000)"
+    )
+    mt.add_argument(
+        "--json", action="store_true", help="JSON exposition instead of "
+        "Prometheus text"
+    )
+    mt.set_defaults(fn=do_metrics)
+
     bd = sub.add_parser("build")
     bd.add_argument("--engine")
     bd.add_argument("--engine-json", default="engine.json")
@@ -736,6 +772,17 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    # the console is the reference's log4j-INFO surface: workflow progress
+    # (incl. the DASE stage breakdown) must reach the operator's terminal
+    import logging
+
+    level = os.environ.get("PIO_LOG_LEVEL", "INFO").upper()
+    if not isinstance(getattr(logging, level, None), int):
+        level = "INFO"  # a typo'd env var must not crash every verb
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
     args = build_parser().parse_args(argv)
     try:
         return args.fn(args)

@@ -1,0 +1,431 @@
+"""Observability subsystem: registry/exposition correctness, thread safety,
+span nesting, serving-path overhead, drain-timeout accounting, and the
+hourly-stats roll fix."""
+
+from __future__ import annotations
+
+import asyncio
+import re
+import threading
+import time
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from predictionio_tpu.obs.metrics import (
+    LATENCY_BUCKETS,
+    SIZE_BUCKETS,
+    MetricsRegistry,
+    quantile_from_buckets,
+)
+from predictionio_tpu.obs.tracing import (
+    clear_traces,
+    recent_traces,
+    trace,
+)
+
+
+class TestHistogramConcurrency:
+    def test_16_threads_preserve_total_count(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("pio_t_seconds", "t")
+        per_thread = 2000
+
+        def worker(seed: int):
+            for i in range(per_thread):
+                h.observe((seed + 1) * 1e-5 + i * 1e-7)
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        counts, total_sum, count = h.snapshot()
+        assert count == 16 * per_thread
+        assert sum(counts) == 16 * per_thread
+        assert total_sum > 0
+
+    def test_counter_concurrent_incs(self):
+        reg = MetricsRegistry()
+        c = reg.counter("pio_t_total", "t")
+        threads = [
+            threading.Thread(
+                target=lambda: [c.inc() for _ in range(1000)]
+            )
+            for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 8000
+
+
+class TestPrometheusExposition:
+    # one metric line: name{labels} value — labels optional, value is a
+    # float, int, or +Inf
+    _line = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+        r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""
+        r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})?"
+        r" (\+Inf|-?[0-9.e+-]+)$"
+    )
+
+    def _populated(self) -> MetricsRegistry:
+        reg = MetricsRegistry()
+        reg.counter("pio_reqs_total", "requests", labelnames=("route",)).labels(
+            "/q"
+        ).inc(3)
+        reg.gauge("pio_depth", "queue depth").set(5)
+        h = reg.histogram(
+            "pio_lat_seconds", "latency", labelnames=("route", "status")
+        )
+        for v in (1e-5, 2e-4, 0.003, 0.7):
+            h.labels("/q", "200").observe(v)
+        h.labels("/q", "500").observe(0.1)
+        return reg
+
+    def test_parses_line_by_line(self):
+        text = self._populated().render_prometheus()
+        assert text.endswith("\n")
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                assert re.match(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*", line)
+            else:
+                assert self._line.match(line), f"unparseable line: {line!r}"
+
+    def test_histogram_buckets_cumulative_and_complete(self):
+        text = self._populated().render_prometheus()
+        lines = [
+            l for l in text.splitlines()
+            if l.startswith('pio_lat_seconds_bucket{route="/q",status="200"')
+        ]
+        # one line per bound plus +Inf, cumulative and ending at the count
+        assert len(lines) == len(LATENCY_BUCKETS) + 1
+        values = [float(l.rsplit(" ", 1)[1]) for l in lines]
+        assert values == sorted(values)
+        assert values[-1] == 4
+        assert 'le="+Inf"' in lines[-1]
+
+    def test_json_exposition_has_quantiles(self):
+        j = self._populated().render_json()
+        series = j["pio_lat_seconds"]["series"]
+        s200 = next(
+            s for s in series if s["labels"]["status"] == "200"
+        )
+        assert s200["count"] == 4
+        assert 0 < s200["p50"] <= s200["p95"] <= s200["p99"] <= 10.0
+
+    def test_kind_conflict_rejected(self):
+        reg = MetricsRegistry()
+        reg.counter("pio_x", "x")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("pio_x", "x")
+
+    def test_bucket_conflict_rejected(self):
+        reg = MetricsRegistry()
+        reg.histogram("pio_h_seconds", "h")  # LATENCY_BUCKETS
+        with pytest.raises(ValueError, match="different buckets"):
+            reg.histogram("pio_h_seconds", "h", buckets=SIZE_BUCKETS)
+
+    def test_stage_buckets_cover_minute_scale(self):
+        from predictionio_tpu.obs.metrics import STAGE_BUCKETS
+
+        reg = MetricsRegistry()
+        h = reg.histogram("pio_stage_seconds", "s", buckets=STAGE_BUCKETS)
+        h.observe(60.0)  # a one-minute train stage must not clamp to 10 s
+        assert 30.0 < h.quantile(0.5) < 150.0
+
+    def test_quantile_math(self):
+        bounds = (1.0, 2.0, 4.0)
+        counts = [0, 100, 0, 0]  # all observations in (1, 2]
+        assert 1.0 <= quantile_from_buckets(bounds, counts, 100, 0.5) <= 2.0
+        assert quantile_from_buckets(bounds, [0, 0, 0, 0], 0, 0.5) == 0.0
+
+
+class TestSpans:
+    def test_nesting_records_parent_child(self):
+        clear_traces()
+        reg = MetricsRegistry()
+        with trace("parent", registry=reg) as parent:
+            with trace("child.a", registry=reg):
+                pass
+            with trace("child.b", registry=reg):
+                with trace("grandchild", registry=reg):
+                    pass
+        assert [c.name for c in parent.children] == ["child.a", "child.b"]
+        assert [c.name for c in parent.children[1].children] == ["grandchild"]
+        # the root landed in the ring with the same shape
+        root = recent_traces(1)[0]
+        assert root["name"] == "parent"
+        assert [c["name"] for c in root["children"]] == ["child.a", "child.b"]
+        # every span fed the histogram
+        fam = reg.get("pio_span_seconds")
+        names = {lv[0] for lv, _ in fam.series()}
+        assert names == {"parent", "child.a", "child.b", "grandchild"}
+
+    def test_span_error_annotated(self):
+        clear_traces()
+        reg = MetricsRegistry()
+        with pytest.raises(RuntimeError):
+            with trace("boom", registry=reg):
+                raise RuntimeError("kaput")
+        root = recent_traces(1)[0]
+        assert root["name"] == "boom" and "kaput" in root["error"]
+
+    def test_thread_local_isolation(self):
+        clear_traces()
+        reg = MetricsRegistry()
+        seen: dict[str, list[str]] = {}
+
+        def worker(name: str):
+            with trace(name, registry=reg) as s:
+                with trace(f"{name}.child", registry=reg):
+                    time.sleep(0.01)
+            seen[name] = [c.name for c in s.children]
+
+        ts = [
+            threading.Thread(target=worker, args=(f"t{i}",)) for i in range(4)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for i in range(4):
+            assert seen[f"t{i}"] == [f"t{i}.child"]
+
+
+class TestOverhead:
+    def test_observe_under_50us(self):
+        """Instrumentation budget: the solo serving path adds a few
+        registry ops per query; each must stay far under 5 µs typical
+        (asserted loosely at 50 µs to avoid CI flakes)."""
+        reg = MetricsRegistry()
+        h = reg.histogram("pio_bench_seconds", "b")
+        h.observe(1e-4)  # warm the family path
+        n = 20000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            h.observe(1e-4)
+        per_op = (time.perf_counter() - t0) / n
+        assert per_op < 50e-6, f"observe cost {per_op * 1e6:.2f}µs"
+
+    def test_labeled_lookup_under_50us(self):
+        reg = MetricsRegistry()
+        fam = reg.histogram(
+            "pio_bench2_seconds", "b", labelnames=("route", "status")
+        )
+        fam.labels("/q", "200").observe(1e-4)
+        n = 20000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fam.labels("/q", "200").observe(1e-4)
+        per_op = (time.perf_counter() - t0) / n
+        assert per_op < 50e-6, f"labeled observe cost {per_op * 1e6:.2f}µs"
+
+
+class TestMicroBatcherMetrics:
+    def test_drain_timeout_param_and_counter(self):
+        from predictionio_tpu.server.microbatch import MicroBatcher
+
+        reg = MetricsRegistry()
+        release = threading.Event()
+
+        def batch_fn(items):
+            release.wait(5)
+            return list(items)
+
+        async def run():
+            b = MicroBatcher(
+                batch_fn, max_batch=1, drain_timeout_s=0.05, registry=reg
+            )
+            assert b.drain_timeout_s == 0.05
+            fut = asyncio.ensure_future(b.submit(1))
+            await asyncio.sleep(0.05)  # wave in flight, held on `release`
+            t0 = time.monotonic()
+            await asyncio.get_running_loop().run_in_executor(None, b.close)
+            waited = time.monotonic() - t0
+            assert waited < 2.0  # honored the short deadline, not 5 s
+            assert (
+                reg.get("pio_microbatch_drain_timeout_total").labels().value
+                == 1
+            )
+            release.set()
+            assert await fut == 1  # abandoned wave still resolves
+
+        asyncio.run(run())
+
+    def test_queue_metrics_and_size_buckets(self):
+        from predictionio_tpu.server.microbatch import MicroBatcher
+
+        reg = MetricsRegistry()
+
+        def batch_fn(items):
+            time.sleep(0.01)
+            return list(items)
+
+        async def run():
+            b = MicroBatcher(batch_fn, max_batch=8, registry=reg)
+            return await asyncio.gather(*(b.submit(i) for i in range(24)))
+
+        assert asyncio.run(run()) == list(range(24))
+        assert reg.get("pio_microbatch_batch_size").buckets == SIZE_BUCKETS
+        bs = reg.get("pio_microbatch_batch_size").labels()
+        assert bs.sum == 24  # every item counted in some wave
+        assert reg.get("pio_microbatch_queue_wait_seconds").labels().count == 24
+        assert reg.get("pio_microbatch_device_seconds").labels().count == bs.count
+
+
+class TestServerMetricsRoutes:
+    def test_event_server_metrics_route(self, storage):
+        from predictionio_tpu.server.event_server import (
+            create_event_server_app,
+        )
+        from predictionio_tpu.server.httpd import Request
+
+        reg = MetricsRegistry()
+        app = create_event_server_app(storage, registry=reg)
+        r = app.handle(Request("GET", "/metrics", {}, {}))
+        assert r.status == 200
+        assert r.content_type.startswith("text/plain")
+        r = app.handle(Request("GET", "/metrics.json", {}, {}))
+        assert r.status == 200 and isinstance(r.body, dict)
+
+    def test_event_server_counts_ingested(self, storage):
+        from predictionio_tpu.server.event_server import (
+            create_event_server_app,
+        )
+        from predictionio_tpu.server.httpd import Request
+        from predictionio_tpu.tools import commands as cmd
+
+        d = cmd.app_new(storage, "obsapp")
+        reg = MetricsRegistry()
+        app = create_event_server_app(storage, registry=reg)
+        body = (
+            b'{"event": "rate", "entityType": "user", "entityId": "u1",'
+            b' "targetEntityType": "item", "targetEntityId": "i1"}'
+        )
+        r = app.handle(
+            Request(
+                "POST",
+                "/events.json",
+                {"accessKey": d.keys[0].key},
+                {},
+                body,
+            )
+        )
+        assert r.status == 201
+        assert (
+            reg.get("pio_events_ingested_total").labels("rate").value == 1
+        )
+        text = reg.render_prometheus()
+        assert 'pio_events_ingested_total{event="rate"} 1' in text
+
+    def test_admin_server_metrics_route(self, storage):
+        from predictionio_tpu.server.admin import create_admin_app
+        from predictionio_tpu.server.httpd import Request
+
+        app = create_admin_app(storage)
+        assert app.handle(Request("GET", "/metrics", {}, {})).status == 200
+
+    def test_dashboard_metrics_table(self, storage):
+        from predictionio_tpu.obs.metrics import REGISTRY
+        from predictionio_tpu.server.dashboard import create_dashboard_app
+        from predictionio_tpu.server.httpd import Request
+
+        REGISTRY.counter("pio_dash_probe_total", "probe").inc()
+        app = create_dashboard_app(storage)
+        r = app.handle(Request("GET", "/", {}, {}))
+        assert r.status == 200
+        assert "<h2>Metrics</h2>" in r.body
+        assert "pio_dash_probe_total" in r.body
+        assert app.handle(Request("GET", "/metrics", {}, {})).status == 200
+
+
+class TestMetricsSnifferPlugin:
+    def test_input_and_output_sniffers(self):
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.obs.plugin import MetricsSnifferPlugin
+        from predictionio_tpu.server.plugins import PluginContext
+
+        reg = MetricsRegistry()
+        ctx = PluginContext()
+        ctx.register(MetricsSnifferPlugin(kind="input", registry=reg))
+        ctx.register(MetricsSnifferPlugin(kind="output", registry=reg))
+        ev = Event(
+            event="buy", entity_type="user", entity_id="u1",
+            properties=DataMap({}),
+        )
+        ctx.process_input(1, None, ev)
+        ctx.process_output("inst-1", {"user": "u1"}, {"score": 1.0})
+        ctx.drain_pending()
+        assert reg.get("pio_sniffed_events_total").labels("buy").value == 1
+        assert (
+            reg.get("pio_sniffed_predictions_total").labels("inst-1").value
+            == 1
+        )
+
+    def test_rest_snapshot(self):
+        from predictionio_tpu.obs.plugin import MetricsSnifferPlugin
+
+        reg = MetricsRegistry()
+        p = MetricsSnifferPlugin(kind="input", registry=reg)
+        p.process(1, None, type("E", (), {"event": "rate"})())
+        out = p.handle_rest("/", {})
+        assert out["counts"] == {"rate": 1.0}
+
+
+class TestHourlyStatsRoll:
+    def _update(self, hs, app_id=1):
+        hs.update(app_id, 201, "user", "item", "rate")
+
+    def test_adjacent_hour_keeps_previous(self, monkeypatch):
+        from predictionio_tpu.server import stats as stats_mod
+
+        t = datetime(2026, 8, 3, 10, 30, tzinfo=timezone.utc)
+        monkeypatch.setattr(stats_mod, "_now", lambda: t)
+        hs = stats_mod.HourlyStats()
+        self._update(hs)
+        t = datetime(2026, 8, 3, 11, 5, tzinfo=timezone.utc)
+        monkeypatch.setattr(stats_mod, "_now", lambda: t)
+        self._update(hs)
+        out = hs.get(1)
+        assert out["previousHour"]["basic"][0]["count"] == 1
+        assert out["previousHour"]["startTime"].startswith(
+            "2026-08-03T10:00"
+        )
+        assert out["previousHour"]["endTime"].startswith("2026-08-03T11:00")
+
+    def test_multi_hour_gap_freezes_previous_to_none(self, monkeypatch):
+        """Regression: an idle gap of >1 hour used to surface the stale
+        old window as previousHour; now the prior hour (no traffic) is
+        reported as absent."""
+        from predictionio_tpu.server import stats as stats_mod
+
+        t = datetime(2026, 8, 3, 10, 30, tzinfo=timezone.utc)
+        monkeypatch.setattr(stats_mod, "_now", lambda: t)
+        hs = stats_mod.HourlyStats()
+        self._update(hs)
+        t = datetime(2026, 8, 3, 14, 10, tzinfo=timezone.utc)  # 4h idle
+        monkeypatch.setattr(stats_mod, "_now", lambda: t)
+        self._update(hs)
+        out = hs.get(1)
+        assert "previousHour" not in out
+        assert out["currentHour"]["startTime"].startswith(
+            "2026-08-03T14:00"
+        )
+
+    def test_gap_exactly_one_hour_rolls_normally(self, monkeypatch):
+        from predictionio_tpu.server import stats as stats_mod
+
+        t = datetime(2026, 8, 3, 10, 59, tzinfo=timezone.utc)
+        monkeypatch.setattr(stats_mod, "_now", lambda: t)
+        hs = stats_mod.HourlyStats()
+        self._update(hs)
+        t = t + timedelta(minutes=2)  # crosses into 11:xx
+        monkeypatch.setattr(stats_mod, "_now", lambda: t)
+        self._update(hs)
+        assert "previousHour" in hs.get(1)

@@ -283,6 +283,7 @@ class TestMicrobatchedQueries:
         from predictionio_tpu.core.engine import resolve_engine_factory
         from predictionio_tpu.core.workflow import run_train
         from predictionio_tpu.models import recommendation  # noqa: F401
+        from predictionio_tpu.obs.metrics import MetricsRegistry
         from predictionio_tpu.server.prediction_server import (
             create_prediction_server,
         )
@@ -329,13 +330,16 @@ class TestMicrobatchedQueries:
             engine_factory="recommendation",
             storage=storage,
         )
+        registry = MetricsRegistry()  # isolated: no cross-test accumulation
         server = create_prediction_server(
             "recommendation",
             host="127.0.0.1",
             port=0,
             storage=storage,
             server_kind="aio",
+            registry=registry,
         ).start_background()
+        server.registry = registry
         yield server
         server.shutdown()
 
@@ -356,6 +360,38 @@ class TestMicrobatchedQueries:
             assert len(body["itemScores"]) == 3
         waves = deployed_server.app.microbatcher.wave_sizes
         assert sum(k * v for k, v in waves.items()) == 48
+        # the registry observed the same traffic: every query's batch-size
+        # and queue-wait sample landed, request latencies were recorded,
+        # and the coalescing rate (queries per wave) exceeds 1 under load —
+        # the implicit batching behavior as an observable invariant
+        reg = deployed_server.registry
+        batch_size = reg.get("pio_microbatch_batch_size").labels()
+        n_waves = batch_size.count
+        assert batch_size.sum == 48  # every query in some wave
+        assert n_waves == sum(waves.values())
+        assert 48 / n_waves > 1.0  # coalescing rate under load
+        assert reg.get("pio_microbatch_queue_wait_seconds").labels().count == 48
+        assert (
+            reg.get("pio_request_latency_seconds")
+            .labels("/queries.json", "200")
+            .count
+            == 48
+        )
+        assert reg.get("pio_microbatch_queue_depth").labels().value >= 0
+
+    def test_metrics_route_serves_prometheus_text(self, deployed_server):
+        base = f"http://127.0.0.1:{deployed_server.port}"
+        _post(base + "/queries.json", {"user": "u1", "num": 3})
+        status, body = _get(base + "/metrics")
+        assert status == 200
+        text = body.decode("utf-8")
+        assert "pio_request_latency_seconds_bucket" in text
+        assert "pio_microbatch_queue_depth" in text
+        assert "pio_microbatch_batch_size_bucket" in text
+        status, body = _get(base + "/metrics.json")
+        assert status == 200
+        parsed = json.loads(body)
+        assert parsed["pio_request_latency_seconds"]["type"] == "histogram"
 
 
 class TestPoisonQueryBisection:
