@@ -1,0 +1,54 @@
+"""`pio check`: JAX-aware static analysis for DASE engines and serving code.
+
+The JVM reference leans on scalac to reject a mis-wired engine before
+`pio train` runs; this package is the Python port's guardrail.  Three rule
+families:
+
+  - PIO-JAX00x  — hot-path device syncs, import-time device work, traced
+                  Python branches in @jit, recompile hazards (rules_jax)
+  - PIO-CONC00x — blocking calls in async handlers, busy-wait polls,
+                  unlocked mutation of lock-guarded state (rules_concurrency)
+  - PIO-DASE00x — DataSource->Preparator->Algorithm->Serving signature /
+                  params-dataclass contract checks (contract; import-based,
+                  lazily loaded so plain lint runs never import jax)
+
+Suppression is inline (``# pio: ignore[RULE]``) or via a checked-in
+baseline with per-entry justifications; `pio check` exits 0 clean /
+1 findings / 2 usage-or-parse error.
+"""
+
+from predictionio_tpu.analysis.analyzer import (  # noqa: F401
+    AnalysisReport,
+    analyze_paths,
+    analyze_source,
+    filter_severity,
+    render_json,
+    render_text,
+)
+from predictionio_tpu.analysis.baseline import (  # noqa: F401
+    DEFAULT_BASELINE_NAME,
+    Baseline,
+    BaselineError,
+)
+from predictionio_tpu.analysis.findings import Finding, Severity  # noqa: F401
+from predictionio_tpu.analysis.rules import ALL_RULES, Rule  # noqa: F401
+
+# importing the rule modules registers them in ALL_RULES
+from predictionio_tpu.analysis import rules_concurrency  # noqa: E402,F401
+from predictionio_tpu.analysis import rules_jax  # noqa: E402,F401
+
+__all__ = [
+    "ALL_RULES",
+    "AnalysisReport",
+    "Baseline",
+    "BaselineError",
+    "DEFAULT_BASELINE_NAME",
+    "Finding",
+    "Rule",
+    "Severity",
+    "analyze_paths",
+    "analyze_source",
+    "filter_severity",
+    "render_json",
+    "render_text",
+]

@@ -1,0 +1,161 @@
+"""Analyzer driver: walk files, run rules, apply suppressions, render.
+
+Stdlib-``ast`` only — analyzing a tree never imports the analyzed code, so
+`pio check` is safe to run on broken or jax-dependent modules from any
+environment (the DASE contract checks in ``contract.py`` are the one
+deliberate exception: they import engine factories on request).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Iterable, Sequence
+
+from predictionio_tpu.analysis.findings import Finding, Severity
+from predictionio_tpu.analysis.pragmas import is_suppressed, pragma_map
+from predictionio_tpu.analysis.rules import ALL_RULES, Rule, parse_module
+
+#: directories never descended into during a scan
+_SKIP_DIRS = frozenset(
+    ("__pycache__", ".git", ".venv", "venv", "node_modules", ".eggs")
+)
+
+
+@dataclass
+class AnalysisReport:
+    """Findings after pragma suppression (baseline applies later)."""
+
+    findings: list[Finding] = field(default_factory=list)
+    errors: list[str] = field(default_factory=list)  # unparseable files etc.
+    files_scanned: int = 0
+    pragma_suppressed: int = 0
+    baseline_suppressed: int = 0
+
+    def summary(self) -> dict[str, Any]:
+        by_sev: dict[str, int] = {}
+        for f in self.findings:
+            by_sev[str(f.severity)] = by_sev.get(str(f.severity), 0) + 1
+        return {
+            "files_scanned": self.files_scanned,
+            "total": len(self.findings),
+            "by_severity": by_sev,
+            "pragma_suppressed": self.pragma_suppressed,
+            "baseline_suppressed": self.baseline_suppressed,
+            "errors": len(self.errors),
+        }
+
+
+def iter_python_files(paths: Sequence[Path | str]) -> list[Path]:
+    out: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_file():
+            out.append(p)
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                # skip-dirs are judged relative to the scan root: a repo
+                # that happens to live UNDER a directory named venv/ must
+                # still scan (only nested venvs inside the tree are skipped)
+                if not any(part in _SKIP_DIRS for part in f.relative_to(p).parts):
+                    out.append(f)
+        else:
+            raise FileNotFoundError(f"no such file or directory: {p}")
+    # de-dup while preserving order (overlapping path args)
+    seen: set[Path] = set()
+    uniq = []
+    for f in out:
+        r = f.resolve()
+        if r not in seen:
+            seen.add(r)
+            uniq.append(f)
+    return uniq
+
+
+def _relpath(path: Path, root: Path) -> str:
+    try:
+        return path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def analyze_source(
+    source: str,
+    rel: str = "<string>",
+    path: Path | None = None,
+    rules: Iterable[Rule] | None = None,
+) -> list[Finding]:
+    """Analyze one source string (fixture tests, editor integrations)."""
+    mod = parse_module(path or Path(rel), rel, source)
+    active = list(rules) if rules is not None else list(ALL_RULES.values())
+    pragmas = pragma_map(mod.lines)
+    findings: list[Finding] = []
+    for r in active:
+        findings.extend(
+            f for f in r.check(mod) if not is_suppressed(f, pragmas)
+        )
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return findings
+
+
+def analyze_paths(
+    paths: Sequence[Path | str],
+    root: Path | str | None = None,
+    rules: Iterable[Rule] | None = None,
+) -> AnalysisReport:
+    """Run every (or the given) rule over all .py files under ``paths``.
+
+    ``root`` anchors the relative paths used in findings and baseline
+    matching; it defaults to the current working directory.
+    """
+    root = Path(root) if root is not None else Path.cwd()
+    active = list(rules) if rules is not None else list(ALL_RULES.values())
+    report = AnalysisReport()
+    for path in iter_python_files(paths):
+        rel = _relpath(path, root)
+        try:
+            source = path.read_text(encoding="utf-8")
+            mod = parse_module(path, rel, source)
+        except (OSError, SyntaxError, ValueError) as e:
+            report.errors.append(f"{rel}: {type(e).__name__}: {e}")
+            continue
+        report.files_scanned += 1
+        pragmas = pragma_map(mod.lines)
+        for r in active:
+            for f in r.check(mod):
+                if is_suppressed(f, pragmas):
+                    report.pragma_suppressed += 1
+                else:
+                    report.findings.append(f)
+    report.findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return report
+
+
+def filter_severity(
+    findings: Iterable[Finding], threshold: Severity
+) -> list[Finding]:
+    return [f for f in findings if f.severity >= threshold]
+
+
+def render_text(report: AnalysisReport) -> str:
+    lines = [f.text() for f in report.findings]
+    lines += [f"error: {e}" for e in report.errors]
+    s = report.summary()
+    suppressed = s["pragma_suppressed"] + s["baseline_suppressed"]
+    tail = (
+        f"{s['total']} finding(s) in {s['files_scanned']} file(s)"
+        + (f", {suppressed} suppressed" if suppressed else "")
+        + (f", {s['errors']} file error(s)" if s["errors"] else "")
+    )
+    lines.append(tail)
+    return "\n".join(lines)
+
+
+def render_json(report: AnalysisReport) -> dict[str, Any]:
+    return {
+        "version": 1,
+        "findings": [f.to_json_dict() for f in report.findings],
+        "errors": list(report.errors),
+        "summary": report.summary(),
+    }

@@ -1,0 +1,248 @@
+"""Rule framework: per-module AST context, rule registry, shared helpers.
+
+Rules operate on a :class:`ModuleInfo` — a parsed module with parent links
+annotated on every node and an import-alias table so ``jnp.dot`` and
+``jax.numpy.dot`` resolve to the same canonical name.  Registration is a
+decorator (:func:`rule`); the analyzer runs every registered rule unless a
+subset is requested.
+"""
+
+from __future__ import annotations
+
+import abc
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Iterator
+
+from predictionio_tpu.analysis.findings import Finding, Severity
+
+#: attribute set on every AST node pointing at its syntactic parent
+_PARENT = "_pio_parent"
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed module handed to every rule."""
+
+    path: Path  # absolute filesystem path
+    rel: str  # posix path relative to the analysis root (finding.file)
+    source: str
+    lines: list[str] = field(default_factory=list)
+    tree: ast.Module | None = None
+    aliases: dict[str, str] = field(default_factory=dict)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+def parse_module(path: Path, rel: str, source: str) -> ModuleInfo:
+    tree = ast.parse(source, filename=str(path))
+    annotate_parents(tree)
+    return ModuleInfo(
+        path=path,
+        rel=rel,
+        source=source,
+        lines=source.splitlines(),
+        tree=tree,
+        aliases=build_aliases(tree),
+    )
+
+
+def annotate_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            setattr(child, _PARENT, node)
+
+
+def parent(node: ast.AST) -> ast.AST | None:
+    return getattr(node, _PARENT, None)
+
+
+def ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    cur = parent(node)
+    while cur is not None:
+        yield cur
+        cur = parent(cur)
+
+
+def enclosing_function(
+    node: ast.AST,
+) -> ast.FunctionDef | ast.AsyncFunctionDef | None:
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return a
+    return None
+
+
+def build_aliases(tree: ast.Module) -> dict[str, str]:
+    """Local name -> canonical dotted path, from module-level imports
+    (including those under module-level if/try, but NOT function-local
+    imports — a `from time import sleep` inside one function must not make
+    a bare `sleep` in another function resolve to time.sleep).
+
+    ``import numpy as np`` -> {'np': 'numpy'};
+    ``from jax import jit`` -> {'jit': 'jax.jit'};
+    ``import jax.numpy as jnp`` -> {'jnp': 'jax.numpy'}.
+    """
+    aliases: dict[str, str] = {}
+    for node in walk_skipping_defs(tree.body):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.partition(".")[0]] = (
+                    a.name if a.asname else a.name.partition(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted_name(expr: ast.AST) -> str | None:
+    """'a.b.c' for Name/Attribute chains; None for anything else."""
+    parts: list[str] = []
+    cur = expr
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve_name(mod: ModuleInfo, expr: ast.AST) -> str:
+    """Canonical dotted name of an expression through the alias table.
+
+    Attribute access on a non-name receiver (``x.item``) renders as
+    ``*.item`` so rules can match method names independent of the receiver.
+    """
+    d = dotted_name(expr)
+    if d is None:
+        if isinstance(expr, ast.Attribute):
+            return "*." + expr.attr
+        return ""
+    head, dot, rest = d.partition(".")
+    base = mod.aliases.get(head, head)
+    return base + dot + rest if rest else base
+
+
+def resolve_call(mod: ModuleInfo, node: ast.Call) -> str:
+    return resolve_name(mod, node.func)
+
+
+def walk_skipping_defs(body: Iterable[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function/class defs
+    or lambda bodies — code in those scopes is deferred, not inline."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda),
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# -- jit decorator introspection (shared by the JAX rules) -------------------
+
+_JIT_NAMES = frozenset(("jax.jit", "jax.pjit", "jax.pmap"))
+
+
+def _is_jit_expr(mod: ModuleInfo, expr: ast.AST) -> bool:
+    return resolve_name(mod, expr) in _JIT_NAMES
+
+
+def jit_decorator_info(
+    mod: ModuleInfo, fn: ast.FunctionDef | ast.AsyncFunctionDef
+) -> tuple[bool, set[str], set[int]]:
+    """(is_jitted, static_argnames, static_argnums) from the decorator list.
+
+    Recognizes ``@jax.jit``, ``@jit`` (aliased import), and
+    ``@partial(jax.jit, static_argnames=..., static_argnums=...)``.
+    """
+    static_names: set[str] = set()
+    static_nums: set[int] = set()
+    jitted = False
+    for dec in fn.decorator_list:
+        kwargs: list[ast.keyword] = []
+        if _is_jit_expr(mod, dec):
+            jitted = True
+        elif isinstance(dec, ast.Call):
+            callee = resolve_name(mod, dec.func)
+            if callee in _JIT_NAMES:
+                jitted = True
+                kwargs = dec.keywords
+            elif callee == "functools.partial" and dec.args and _is_jit_expr(
+                mod, dec.args[0]
+            ):
+                jitted = True
+                kwargs = dec.keywords
+        for kw in kwargs:
+            if kw.arg == "static_argnames":
+                static_names |= _const_strings(kw.value)
+            elif kw.arg == "static_argnums":
+                static_nums |= _const_ints(kw.value)
+    return jitted, static_names, static_nums
+
+
+def _const_strings(expr: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.add(node.value)
+    return out
+
+
+def _const_ints(expr: ast.AST) -> set[int]:
+    out: set[int] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            out.add(node.value)
+    return out
+
+
+# -- registry ---------------------------------------------------------------
+
+
+class Rule(abc.ABC):
+    """One lint: an id, a fixed severity, and an AST check."""
+
+    id: str = ""
+    severity: Severity = Severity.MEDIUM
+    summary: str = ""
+
+    @abc.abstractmethod
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]: ...
+
+    def finding(self, mod: ModuleInfo, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=self.id,
+            severity=self.severity,
+            file=mod.rel,
+            line=line,
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+            source=mod.line_text(line),
+        )
+
+
+#: id -> rule instance; populated by the @rule decorator at import time
+ALL_RULES: dict[str, Rule] = {}
+
+
+def rule(cls: type[Rule]) -> type[Rule]:
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if inst.id in ALL_RULES:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    ALL_RULES[inst.id] = inst
+    return cls

@@ -1,0 +1,315 @@
+"""JAX hot-path lints (rule family PIO-JAX*).
+
+The failure modes these catch are the classic TPU-serving ones: a silent
+host<->device sync inside the per-query path (each ``.item()`` stalls the
+dispatch pipeline), device work at module import (allocates buffers before
+the mesh is configured), Python control flow on traced values (TracerBool
+errors at first call, or silent recompiles), and per-iteration ``jax.jit``
+construction (every wrap is a fresh cache entry — retrace + recompile).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from predictionio_tpu.analysis.findings import Finding, Severity
+from predictionio_tpu.analysis.rules import (
+    ModuleInfo,
+    Rule,
+    ancestors,
+    jit_decorator_info,
+    parent,
+    resolve_call,
+    rule,
+    walk_skipping_defs,
+)
+
+#: DASE serving-surface method names + microbatch dispatch conventions —
+#: the functions that run once per query (or per wave) under load.
+HOT_FUNCTION_NAMES = frozenset(
+    ("predict", "batch_predict", "serve", "supplement")
+)
+HOT_NAME_FRAGMENTS = ("serve_wave", "batch_fn")
+
+#: calls that force a device->host transfer when applied to a jax array
+_SYNC_CALLS = frozenset(
+    ("jax.device_get", "numpy.asarray", "numpy.array", "numpy.copy")
+)
+
+
+def _is_hot_function(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    name = fn.name
+    return name in HOT_FUNCTION_NAMES or any(
+        frag in name for frag in HOT_NAME_FRAGMENTS
+    )
+
+
+def _hot_functions(
+    mod: ModuleInfo,
+) -> Iterator[ast.FunctionDef | ast.AsyncFunctionDef]:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _is_hot_function(node):
+                yield node
+
+
+@rule
+class HotPathDeviceSync(Rule):
+    """PIO-JAX001: implicit device sync inside a serving hot-path function."""
+
+    id = "PIO-JAX001"
+    severity = Severity.MEDIUM
+    summary = (
+        "host sync (.item()/device_get/np.asarray) inside a hot-path "
+        "function; sync once per batch, not per query"
+    )
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for fn in _hot_functions(mod):
+            for node in walk_skipping_defs(fn.body):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = resolve_call(mod, node)
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item"
+                    and not node.args
+                ):
+                    yield self.finding(
+                        mod,
+                        node,
+                        f".item() in hot-path function {fn.name!r} forces a "
+                        "device->host sync per call; pull the batched output "
+                        "once (jax.device_get) outside the per-query loop",
+                    )
+                elif callee in _SYNC_CALLS:
+                    yield self.finding(
+                        mod,
+                        node,
+                        f"{callee}(...) in hot-path function {fn.name!r} "
+                        "synchronizes device buffers to host; hoist the "
+                        "transfer out of the per-query path",
+                    )
+
+
+@rule
+class ImportTimeDeviceWork(Rule):
+    """PIO-JAX002: jnp/jax.random work executed at module import time."""
+
+    id = "PIO-JAX002"
+    severity = Severity.HIGH
+    summary = (
+        "jax.numpy/jax.random call at module import time; device buffers "
+        "allocate before mesh/platform configuration"
+    )
+
+    _PREFIXES = ("jax.numpy.", "jax.random.")
+    _EXACT = frozenset(("jax.device_put", "jax.devices", "jax.local_devices"))
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for node in self._import_time_nodes(mod.tree.body):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = resolve_call(mod, node)
+            if callee.startswith(self._PREFIXES) or callee in self._EXACT:
+                yield self.finding(
+                    mod,
+                    node,
+                    f"{callee}(...) runs at import time: JAX initializes its "
+                    "backend and allocates device memory before the "
+                    "application configures platforms/mesh; build the value "
+                    "lazily inside a function",
+                )
+
+    def _import_time_nodes(self, body: list[ast.stmt]) -> Iterator[ast.AST]:
+        """Module and class bodies execute at import (at any nesting depth
+        under module-level if/try/with); function and lambda bodies do not —
+        but their decorators and default arguments DO, so those subtrees are
+        still walked.  The `if __name__ == '__main__'` block is exempt."""
+        stack: list[ast.AST] = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stack.extend(node.decorator_list)
+                stack.extend(d for d in node.args.defaults)
+                stack.extend(d for d in node.args.kw_defaults if d is not None)
+                continue
+            if isinstance(node, ast.Lambda):
+                stack.extend(node.args.defaults)
+                stack.extend(d for d in node.args.kw_defaults if d is not None)
+                continue
+            if isinstance(node, ast.If) and _is_main_guard(node):
+                # the guarded body is script-only, but the else arm runs on
+                # every import (it IS the non-__main__ case)
+                stack.extend(node.orelse)
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_main_guard(stmt: ast.If) -> bool:
+    """True only for the literal ``if __name__ == "__main__":`` shape —
+    an ``!=`` (or a different comparand) still executes at import."""
+    t = stmt.test
+    if not (
+        isinstance(t, ast.Compare)
+        and len(t.ops) == 1
+        and isinstance(t.ops[0], ast.Eq)
+    ):
+        return False
+    sides = (t.left, t.comparators[0])
+    return any(
+        isinstance(s, ast.Name) and s.id == "__name__" for s in sides
+    ) and any(
+        isinstance(s, ast.Constant) and s.value == "__main__" for s in sides
+    )
+
+
+#: attribute reads on a traced value that are static (safe to branch on)
+_STATIC_ATTRS = frozenset(("shape", "ndim", "dtype", "size"))
+
+
+@rule
+class TracedPythonBranch(Rule):
+    """PIO-JAX003: Python if/while on a traced argument inside a jitted fn."""
+
+    id = "PIO-JAX003"
+    severity = Severity.HIGH
+    summary = (
+        "Python control flow on a traced value inside @jit; use lax.cond/"
+        "select or mark the argument static"
+    )
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            jitted, static_names, static_nums = jit_decorator_info(mod, fn)
+            if not jitted:
+                continue
+            args = fn.args.posonlyargs + fn.args.args
+            traced = {
+                a.arg
+                for i, a in enumerate(args)
+                if a.arg not in static_names and i not in static_nums
+            } | {a.arg for a in fn.args.kwonlyargs if a.arg not in static_names}
+            traced.discard("self")
+            for node in walk_skipping_defs(fn.body):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                name = _traced_name_in_test(node.test, traced)
+                if name is not None:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield self.finding(
+                        mod,
+                        node,
+                        f"Python `{kind}` on traced argument {name!r} inside "
+                        f"jitted function {fn.name!r}: this raises a tracer "
+                        "error (or silently recompiles per value); use "
+                        "jax.lax.cond/jnp.where or static_argnames",
+                    )
+
+
+def _traced_name_in_test(test: ast.AST, traced: set[str]) -> str | None:
+    """First traced param the test depends on concretely, else None.
+
+    Exemptions are scoped to the exact subtree they cover — `y is not None
+    and x > 0` exempts only the identity check (and still flags ``x``), and
+    an isinstance() call exempts only its own operands, never a traced
+    comparison elsewhere in the same compound condition.
+    """
+    exempt: set[int] = set()
+    for node in ast.walk(test):
+        concrete = (
+            # identity checks are resolved on the Python value, not traced
+            isinstance(node, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops)
+        ) or (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "isinstance"
+        )
+        if concrete:
+            exempt.update(id(sub) for sub in ast.walk(node))
+    for node in ast.walk(test):
+        if (
+            isinstance(node, ast.Name)
+            and node.id in traced
+            and id(node) not in exempt
+        ):
+            par = parent(node)
+            if isinstance(par, ast.Attribute) and par.attr in _STATIC_ATTRS:
+                continue
+            if (  # len(x) of a traced array is its static leading dim
+                isinstance(par, ast.Call)
+                and isinstance(par.func, ast.Name)
+                and par.func.id == "len"
+            ):
+                continue
+            return node.id
+    return None
+
+
+@rule
+class JitConstructionInLoop(Rule):
+    """PIO-JAX004: jax.jit(...) wrapped inside a loop body (recompile hazard)."""
+
+    id = "PIO-JAX004"
+    severity = Severity.HIGH
+    summary = (
+        "jax.jit(...) constructed inside a loop; each wrap is a fresh trace "
+        "cache — hoist the jitted callable out of the loop"
+    )
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if resolve_call(mod, node) not in ("jax.jit", "jax.pjit"):
+                continue
+            for anc in ancestors(node):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    break  # jit built per *call* of an inner fn, not per iter
+                if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+                    yield self.finding(
+                        mod,
+                        node,
+                        "jax.jit(...) inside a loop creates a new traced "
+                        "callable every iteration (no cache reuse, repeated "
+                        "XLA compiles); hoist it out of the loop",
+                    )
+                    break
+
+
+@rule
+class JitMutableDefault(Rule):
+    """PIO-JAX005: jitted function with a mutable (unhashable) default arg."""
+
+    id = "PIO-JAX005"
+    severity = Severity.MEDIUM
+    summary = (
+        "mutable default argument on a jitted function; unhashable if "
+        "static, retrace hazard if traced"
+    )
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            jitted, _, _ = jit_decorator_info(mod, fn)
+            if not jitted:
+                continue
+            defaults = list(fn.args.defaults) + [
+                d for d in fn.args.kw_defaults if d is not None
+            ]
+            for d in defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    yield self.finding(
+                        mod,
+                        d,
+                        f"mutable default argument on jitted function "
+                        f"{fn.name!r}: unhashable under static_argnums and a "
+                        "per-call retrace hazard when traced; use a tuple or "
+                        "None-sentinel",
+                    )

@@ -89,6 +89,17 @@ class MicroBatcher:
             "close() deadlines expired with a wave still in flight",
         )
 
+    def wave_histogram(self) -> dict[int, int]:
+        """Consistent snapshot of the wave-size histogram.
+
+        Prefer this over reading ``wave_sizes`` directly while traffic is
+        in flight: the worker mutates the dict under ``_cond``, and an
+        unlocked concurrent iteration can raise ``RuntimeError: dictionary
+        changed size during iteration``.
+        """
+        with self._cond:
+            return dict(self.wave_sizes)
+
     async def submit(self, item: Any) -> Any:
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
@@ -124,13 +135,14 @@ class MicroBatcher:
                 # the futures' loop is already closed (server tore the
                 # loop down first) — nothing can await them anymore
                 pass
-        deadline = time.monotonic() + self.drain_timeout_s
-        while time.monotonic() < deadline:
-            with self._cond:
-                if not self._in_wave:
-                    return
-            time.sleep(0.01)
-        self._m_drain_timeout.inc()
+        # sleep on the condition until the worker clears _in_wave (it
+        # notifies at end of wave) instead of polling: wakeup is immediate
+        # and no CPU burns while a long device dispatch drains
+        with self._cond:
+            if not self._cond.wait_for(
+                lambda: not self._in_wave, timeout=self.drain_timeout_s
+            ):
+                self._m_drain_timeout.inc()
 
     def _drain(self) -> None:
         """Persistent worker loop: sleep on the condition until work (or
@@ -164,15 +176,19 @@ class MicroBatcher:
                         f"batch_fn returned {len(results)} results "
                         f"for {len(items)} items"
                     )
-                self.wave_sizes[len(items)] = (
-                    self.wave_sizes.get(len(items), 0) + 1
-                )
+                # under the cond: the status page reads wave_sizes from
+                # other threads, and dict writes must not race its snapshot
+                with self._cond:
+                    self.wave_sizes[len(items)] = (
+                        self.wave_sizes.get(len(items), 0) + 1
+                    )
                 self._post(loop, futures, results, None)
             except Exception as e:
                 self._post(loop, futures, None, e)
             finally:
                 with self._cond:
                     self._in_wave = False
+                    self._cond.notify_all()  # wake close() waiters
 
     @staticmethod
     def _post(loop, futures, results, error) -> None:

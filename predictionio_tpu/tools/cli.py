@@ -155,13 +155,49 @@ def do_export(args) -> int:
     return 0
 
 
+def _dase_preflight(factory_name: str, engine=None, skip: bool = False) -> int:
+    """Static DASE contract check before any device work (the scalac role).
+
+    Returns 0 when clean/skipped, 1 when the wiring is broken — the caller
+    aborts before touching storage or devices.  ``--no-check`` skips.
+    """
+    if skip or not factory_name:
+        return 0
+    from predictionio_tpu.analysis.contract import (
+        check_engine,
+        check_engine_contract,
+    )
+
+    root = Path.cwd()  # repo-relative paths in the printed findings
+    findings = (
+        check_engine(engine, factory_name, root=root)
+        if engine is not None
+        else check_engine_contract(factory_name, root=root)
+    )
+    if not findings:
+        return 0
+    for f in findings:
+        print(f.text(), file=sys.stderr)
+    print(
+        f"DASE pre-flight failed for engine {factory_name!r}: "
+        f"{len(findings)} contract violation(s) — fix the wiring or pass "
+        "--no-check to skip",
+        file=sys.stderr,
+    )
+    return 1
+
+
 def do_train(args) -> int:
     from predictionio_tpu.core.base import EngineContext
     from predictionio_tpu.core.workflow import WorkflowParams, run_train
     from predictionio_tpu.parallel.mesh import MeshConfig, initialize_distributed
 
+    # distributed bootstrap FIRST: jax.distributed.initialize must run
+    # before anything (engine imports included) can initialize the backend
     initialize_distributed()
     factory_name, engine, variant = _resolve_engine(args)
+    if _dase_preflight(factory_name, engine, skip=args.no_check):
+        return 1
     params = engine.params_from_json(variant)
     ctx = EngineContext(
         mesh_config=MeshConfig.from_dict(variant.get("mesh")),
@@ -232,6 +268,8 @@ def do_deploy(args) -> int:
 
     _load_engine_modules()
     factory, engine_id, engine_version, engine_variant = _engine_coords(args)
+    if _dase_preflight(factory, skip=args.no_check):
+        return 1
     server = create_prediction_server(
         factory,
         host=args.ip,
@@ -564,6 +602,96 @@ def do_metrics(args) -> int:
     return 0
 
 
+def do_check(args) -> int:
+    """`pio check`: JAX-aware static analysis + DASE contract pre-flight.
+
+    Exit-code contract (same in text and --format json): 0 = clean,
+    1 = findings at/above --severity, 2 = usage or parse error.
+    """
+    from predictionio_tpu.analysis import (
+        DEFAULT_BASELINE_NAME,
+        Baseline,
+        BaselineError,
+        Severity,
+        analyze_paths,
+        filter_severity,
+        render_json,
+        render_text,
+    )
+
+    try:
+        threshold = Severity.parse(args.severity)
+    except ValueError as e:
+        print(f"usage error: {e}", file=sys.stderr)
+        return 2
+
+    engines = list(args.engine or [])
+    paths = list(args.paths)
+    if not paths and not engines:
+        paths = ["."]
+
+    try:
+        report = analyze_paths(paths)  # [] (engine-only run) => empty report
+    except FileNotFoundError as e:
+        print(f"usage error: {e}", file=sys.stderr)
+        return 2
+
+    # DASE contract checks (import the named engine factories)
+    if engines:
+        from predictionio_tpu.analysis.contract import check_engine_contract
+        from predictionio_tpu.core.engine import engine_registry
+
+        _load_engine_modules()
+        if "all" in engines:
+            bundled = engine_registry.names()
+            extra = [e for e in engines if e != "all" and e not in bundled]
+            engines = bundled + extra
+        for name in engines:
+            report.findings.extend(check_engine_contract(name, root=Path.cwd()))
+        # keep the file:line ordering contract across both finding sources
+        report.findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+
+    if args.write_baseline:
+        # the baseline must be complete: unfiltered by --severity, and
+        # refused outright when a file failed to parse (its findings would
+        # be silently missing from the snapshot)
+        if report.errors:
+            for e in report.errors:
+                print(f"error: {e}", file=sys.stderr)
+            print(
+                "refusing to write a baseline while files fail to parse",
+                file=sys.stderr,
+            )
+            return 2
+        target = args.baseline or DEFAULT_BASELINE_NAME
+        n = Baseline.write(target, report.findings)
+        print(f"Wrote {n} baseline entr{'y' if n == 1 else 'ies'} to {target}")
+        return 0
+
+    report.findings = filter_severity(report.findings, threshold)
+
+    baseline_path = args.baseline
+    if baseline_path is None and Path(DEFAULT_BASELINE_NAME).exists():
+        baseline_path = DEFAULT_BASELINE_NAME
+    if baseline_path is not None:
+        try:
+            baseline = Baseline.load(baseline_path)
+        except BaselineError as e:
+            print(f"usage error: {e}", file=sys.stderr)
+            return 2
+        report.findings, report.baseline_suppressed = baseline.filter(
+            report.findings
+        )
+
+    if args.format == "json":
+        _print(render_json(report))
+    else:
+        print(render_text(report))
+    if report.errors:
+        return 2
+    return 1 if report.findings else 0
+
+
 def do_build(args) -> int:
     """`pio build` parity: engines are plain Python — nothing to compile.
     Validates the engine.json instead (the useful part of the verb)."""
@@ -656,6 +784,11 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--skip-sanity-check", action="store_true")
     tr.add_argument("--stop-after-read", action="store_true")
     tr.add_argument("--stop-after-prepare", action="store_true")
+    tr.add_argument(
+        "--no-check",
+        action="store_true",
+        help="skip the static DASE contract pre-flight",
+    )
     tr.set_defaults(fn=do_train)
 
     ev = sub.add_parser("eval")
@@ -672,6 +805,11 @@ def build_parser() -> argparse.ArgumentParser:
     dp.add_argument("--port", type=int, default=8000)
     dp.add_argument("--feedback", action="store_true")
     dp.add_argument("--accesskey", default="")
+    dp.add_argument(
+        "--no-check",
+        action="store_true",
+        help="skip the static DASE contract pre-flight",
+    )
     dp.set_defaults(fn=do_deploy)
 
     ud = sub.add_parser("undeploy")
@@ -762,6 +900,49 @@ def build_parser() -> argparse.ArgumentParser:
         "Prometheus text"
     )
     mt.set_defaults(fn=do_metrics)
+
+    ck = sub.add_parser(
+        "check",
+        description=(
+            "JAX-aware static analysis: hot-path device-sync lints "
+            "(PIO-JAX*), concurrency lints (PIO-CONC*), and DASE contract "
+            "checks (PIO-DASE*, via --engine).  Exit codes: 0 = clean, "
+            "1 = findings at/above --severity, 2 = usage or parse error.  "
+            "Suppress inline with '# pio: ignore[RULE]' or via a baseline "
+            "file (.pio-check-baseline.json is auto-discovered in the "
+            "working directory)."
+        ),
+    )
+    ck.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to analyze (default: current directory)",
+    )
+    ck.add_argument(
+        "--engine",
+        action="append",
+        help="also run DASE contract checks for this engine factory "
+        "(repeatable; 'all' = every bundled engine)",
+    )
+    ck.add_argument("--format", choices=["text", "json"], default="text")
+    ck.add_argument(
+        "--severity",
+        default="low",
+        help="minimum severity reported and counted toward the exit code "
+        "(low/medium/high; default low)",
+    )
+    ck.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline file of suppressed findings (default: "
+        ".pio-check-baseline.json if present)",
+    )
+    ck.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write the current findings to the baseline file and exit 0",
+    )
+    ck.set_defaults(fn=do_check)
 
     bd = sub.add_parser("build")
     bd.add_argument("--engine")

@@ -1,0 +1,20 @@
+"""Fixture: PIO-CONC001 — blocking calls inside async handlers."""
+
+import asyncio
+import subprocess
+import time
+
+
+async def handler(req):
+    time.sleep(0.1)  # line 9: CONC001 (blocks the loop)
+    subprocess.run(["ls"])  # line 10: CONC001 (blocks the loop)
+    return req
+
+
+async def fine(req):
+    await asyncio.sleep(0.1)  # clean: awaited
+
+    def helper():
+        time.sleep(0.1)  # clean: sync helper, runs wherever it is called
+
+    return helper

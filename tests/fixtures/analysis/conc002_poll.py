@@ -1,0 +1,14 @@
+"""Fixture: PIO-CONC002 — busy-wait polling loops."""
+
+import time
+
+
+def wait_done(worker):
+    while not worker.done:  # line 7: CONC002 (poll loop)
+        time.sleep(0.01)
+    return True
+
+
+def plain_sleep():
+    time.sleep(1.0)  # clean: no loop
+    return True

@@ -1,0 +1,28 @@
+"""Fixture: PIO-CONC003 — unlocked mutation of lock-guarded state."""
+
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+        self.count = 0
+
+    def add(self, x):
+        with self._lock:
+            self.items.append(x)
+            self.count += 1
+
+    def sneaky_append(self, x):
+        self.items.append(x)  # line 18: CONC003 (guarded attr, no lock)
+
+    def sneaky_reset(self):
+        self.count = 0  # line 21: CONC003 (guarded attr, no lock)
+
+    def read(self):
+        return self.count  # clean: reads are not flagged
+
+    def locked_reset(self):
+        with self._lock:
+            self.count = 0  # clean: under the lock

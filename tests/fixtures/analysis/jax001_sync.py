@@ -1,0 +1,24 @@
+"""Fixture: PIO-JAX001 — host syncs inside hot-path functions."""
+
+import numpy as np
+
+import jax
+
+
+def predict(model, query):
+    scores = model.fn(query)
+    best = np.asarray(scores)  # line 10: JAX001 (np.asarray in predict)
+    return best[0]
+
+
+def batch_predict(model, queries):
+    out = model.fn(queries)
+    return out.item()  # line 16: JAX001 (.item in batch_predict)
+
+
+def serve(query, predictions):
+    return jax.device_get(predictions)  # line 20: JAX001 (device_get in serve)
+
+
+def prepare(ctx, td):
+    return np.asarray(td)  # clean: not a hot-path function

@@ -1,0 +1,18 @@
+"""Fixture: PIO-JAX002 — device work at module import time."""
+
+import jax.numpy as jnp
+from jax import random
+
+_TABLE = jnp.arange(1024)  # line 6: JAX002 (module-level jnp)
+
+
+class Holder:
+    KEY = random.PRNGKey(0)  # line 10: JAX002 (class body runs at import)
+
+
+def fine():
+    return jnp.zeros(3)  # clean: inside a function
+
+
+if __name__ == "__main__":
+    print(jnp.ones(2))  # clean: main guard does not run at import

@@ -1,0 +1,32 @@
+"""Fixture: PIO-JAX003 — Python control flow on traced values in @jit."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def relu_bad(x):
+    if x > 0:  # line 11: JAX003 (Python if on traced arg)
+        return x
+    return jnp.zeros_like(x)
+
+
+@partial(jax.jit, static_argnames=("flag",))
+def gated(x, flag):
+    if flag:  # clean: flag is static
+        return x * 2
+    if x.shape[0] > 1:  # clean: shape is static under trace
+        return x + 1
+    if x is None:  # clean: identity check is concrete
+        return x
+    while x > 0:  # line 24: JAX003 (Python while on traced arg)
+        x = x - 1
+    return x
+
+
+def plain(x):
+    if x > 0:  # clean: not jitted
+        return x
+    return -x

@@ -1,0 +1,29 @@
+"""Fixture: PIO-JAX004 — jax.jit constructed inside a loop."""
+
+import jax
+
+
+def per_step_jit(fns, xs):
+    outs = []
+    for f in fns:
+        jf = jax.jit(f)  # line 9: JAX004 (fresh trace cache per iteration)
+        outs.append(jf(xs))
+    return outs
+
+
+def hoisted(f, xs):
+    jf = jax.jit(f)  # clean: wrapped once
+    out = []
+    for x in xs:
+        out.append(jf(x))
+    return out
+
+
+def loop_calls_factory(fns, xs):
+    def make(f):
+        return jax.jit(f)  # clean: built per call of make, not per iter
+
+    out = []
+    for f in fns:
+        out.append(make(f)(xs))
+    return out

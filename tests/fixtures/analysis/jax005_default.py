@@ -1,0 +1,12 @@
+"""Fixture: PIO-JAX005 — mutable default argument on a jitted function."""
+
+import jax
+
+
+@jax.jit
+def bad(x, opts=[]):  # line 7: JAX005 (list default on jitted fn)
+    return x
+
+
+def plain(x, opts=[]):  # clean: not jitted
+    return x

@@ -1,0 +1,22 @@
+"""Fixture: inline pragma suppression round-trip."""
+
+import time
+
+
+def poller(worker):
+    while not worker.done:  # pio: ignore[PIO-CONC002]
+        time.sleep(0.5)
+    return True
+
+
+def poller_wildcard(worker):
+    # pio: ignore[*]
+    while not worker.done:
+        time.sleep(0.5)
+    return True
+
+
+def unsuppressed(worker):
+    while not worker.done:  # line 20: CONC002 still fires here
+        time.sleep(0.5)
+    return True

@@ -1,0 +1,699 @@
+"""`pio check` analyzer tests: rule corpus with exact-line assertions,
+pragma/baseline suppression round-trips, the CLI exit-code contract
+(0 clean / 1 findings / 2 usage-or-parse error), and the DASE contract
+checker (good engines clean, broken wiring reported, train/deploy
+pre-flight abort + --no-check skip)."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from predictionio_tpu.analysis import (
+    ALL_RULES,
+    Baseline,
+    Severity,
+    analyze_paths,
+    analyze_source,
+    filter_severity,
+)
+from predictionio_tpu.tools.cli import main as cli_main
+
+FIXTURES = Path(__file__).parent / "fixtures" / "analysis"
+
+
+def findings_for(name: str):
+    return analyze_source((FIXTURES / name).read_text(), name)
+
+
+def triples(name: str):
+    return [(f.rule, f.line, str(f.severity)) for f in findings_for(name)]
+
+
+class TestRuleCorpus:
+    """One fixture per rule; rule id, severity, and exact line asserted."""
+
+    def test_jax001_hot_path_sync(self):
+        assert triples("jax001_sync.py") == [
+            ("PIO-JAX001", 10, "medium"),
+            ("PIO-JAX001", 16, "medium"),
+            ("PIO-JAX001", 20, "medium"),
+        ]
+
+    def test_jax002_import_time_device_work(self):
+        assert triples("jax002_import.py") == [
+            ("PIO-JAX002", 6, "high"),
+            ("PIO-JAX002", 10, "high"),
+        ]
+
+    def test_jax003_traced_branch(self):
+        assert triples("jax003_branch.py") == [
+            ("PIO-JAX003", 11, "high"),
+            ("PIO-JAX003", 24, "high"),
+        ]
+
+    def test_jax004_jit_in_loop(self):
+        assert triples("jax004_loop.py") == [("PIO-JAX004", 9, "high")]
+
+    def test_jax005_mutable_default(self):
+        assert triples("jax005_default.py") == [("PIO-JAX005", 7, "medium")]
+
+    def test_conc001_blocking_in_async(self):
+        assert triples("conc001_async.py") == [
+            ("PIO-CONC001", 9, "high"),
+            ("PIO-CONC001", 10, "high"),
+        ]
+
+    def test_conc002_busy_wait(self):
+        assert triples("conc002_poll.py") == [("PIO-CONC002", 7, "high")]
+
+    def test_conc003_unlocked_mutation(self):
+        assert triples("conc003_lock.py") == [
+            ("PIO-CONC003", 18, "high"),
+            ("PIO-CONC003", 21, "high"),
+        ]
+
+    def test_every_shipped_rule_has_fixture_coverage(self):
+        """The corpus exercises every registered AST rule."""
+        seen = {
+            f.rule
+            for name in (
+                "jax001_sync.py",
+                "jax002_import.py",
+                "jax003_branch.py",
+                "jax004_loop.py",
+                "jax005_default.py",
+                "conc001_async.py",
+                "conc002_poll.py",
+                "conc003_lock.py",
+            )
+            for f in findings_for(name)
+        }
+        assert seen == set(ALL_RULES)
+
+    def test_jax002_skips_deferred_code_under_module_if_try(self):
+        """Defs/lambdas nested in module-level try/if are deferred, not
+        import-time — but their decorators and defaults DO run at import."""
+        src = (
+            "import jax.numpy as jnp\n"
+            "try:\n"
+            "    import fastpath\n"
+            "except ImportError:\n"
+            "    def fallback():\n"
+            "        return jnp.zeros(3)\n"  # deferred: clean
+            "L = lambda: jnp.zeros(3)\n"  # deferred: clean
+            "def decorated(x=jnp.zeros(2)):\n"  # default runs at import
+            "    return x\n"
+        )
+        assert [(f.rule, f.line) for f in analyze_source(src)] == [
+            ("PIO-JAX002", 8)
+        ]
+
+    def test_jax002_main_guard_is_literal_eq_only(self):
+        """`if __name__ != "__main__":` executes at import — not exempt;
+        the reversed-operand literal guard IS exempt."""
+        src = (
+            "import jax.numpy as jnp\n"
+            'if __name__ != "__main__":\n'
+            "    T = jnp.zeros(8)\n"  # runs on import: flagged
+            'if "__main__" == __name__:\n'
+            "    U = jnp.zeros(8)\n"  # script-only: clean
+        )
+        assert [(f.rule, f.line) for f in analyze_source(src)] == [
+            ("PIO-JAX002", 3)
+        ]
+
+    def test_lambda_bodies_are_deferred(self):
+        """Code inside a lambda never runs where it is written — no
+        CONC001/CONC002 findings for sleeps in lambda bodies."""
+        src = (
+            "import time\n"
+            "async def handler():\n"
+            "    retry = lambda: time.sleep(0.1)\n"  # deferred: clean
+            "    return retry\n"
+            "def spin(q):\n"
+            "    while q.busy:\n"
+            "        q.cb = lambda: time.sleep(0.01)\n"  # deferred: clean
+        )
+        assert analyze_source(src) == []
+
+    def test_jax002_main_guard_else_arm_runs_at_import(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            'if __name__ == "__main__":\n'
+            "    print(jnp.ones(2))\n"  # script-only: clean
+            "else:\n"
+            "    T = jnp.zeros(1024)\n"  # line 5: runs on every import
+        )
+        assert [(f.rule, f.line) for f in analyze_source(src)] == [
+            ("PIO-JAX002", 5)
+        ]
+
+    def test_conc001_sock_recv_in_async(self):
+        src = (
+            "async def h(sock):\n"
+            "    return sock.recv(4096)\n"
+        )
+        assert [f.rule for f in analyze_source(src)] == ["PIO-CONC001"]
+
+    def test_jax003_exemptions_are_subtree_scoped(self):
+        """`y is not None` in a compound test exempts only y — a traced
+        comparison beside it is still caught; and an isinstance() call must
+        not launder a traced comparison in the same condition."""
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def g(x, *, y=None):\n"
+            "    if y is not None:\n"  # clean: identity check alone
+            "        x = x + y\n"
+            "    if y is not None and x > 0:\n"  # line 6: x is traced
+            "        return x\n"
+            "    return x\n"
+        )
+        fs = analyze_source(src)
+        assert [(f.rule, f.line) for f in fs] == [("PIO-JAX003", 6)]
+        assert "'x'" in fs[0].message  # attributed to x, not y
+        src2 = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x, mode):\n"
+            "    if isinstance(mode, str) and x > 0:\n"  # x still traced
+            "        return x\n"
+            "    return x\n"
+        )
+        assert [(f.rule, f.line) for f in analyze_source(src2)] == [
+            ("PIO-JAX003", 4)
+        ]
+
+    def test_jax003_len_of_traced_arg_is_static(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    if len(x) > 3:\n"  # len() under jit is a static int
+            "        return x\n"
+            "    return x + 1\n"
+        )
+        assert analyze_source(src) == []
+
+    def test_conc003_tuple_assignment_targets(self):
+        src = (
+            "import threading\n"
+            "class Box:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def locked(self):\n"
+            "        with self._lock:\n"
+            "            self.n, self.m = 1, 2\n"
+            "    def sneaky(self):\n"
+            "        self.n, self.m = 3, 4\n"  # both unlocked writes flagged
+        )
+        got = [(f.rule, f.line) for f in analyze_source(src)]
+        assert got == [("PIO-CONC003", 9), ("PIO-CONC003", 9)]
+
+    def test_findings_carry_source_text(self):
+        f = findings_for("conc002_poll.py")[0]
+        assert f.source == "while not worker.done:  # line 7: CONC002 (poll loop)"
+        assert f.file == "conc002_poll.py"
+        assert f.col > 0
+
+
+class TestPragmas:
+    def test_inline_and_comment_line_pragmas(self):
+        got = triples("pragma_suppress.py")
+        # two suppressed (same-line pragma + comment-line wildcard), one kept
+        assert got == [("PIO-CONC002", 20, "high")]
+
+    def test_pragma_only_matches_named_rule(self):
+        src = (
+            "import time\n"
+            "def f(w):\n"
+            "    while not w.done:  # pio: ignore[PIO-JAX001]\n"
+            "        time.sleep(1)\n"
+        )
+        assert [f.rule for f in analyze_source(src)] == ["PIO-CONC002"]
+
+
+class TestBaseline:
+    def test_round_trip(self, tmp_path):
+        findings = findings_for("conc003_lock.py")
+        assert len(findings) == 2
+        path = tmp_path / "baseline.json"
+        assert Baseline.write(path, findings) == 2
+        remaining, suppressed = Baseline.load(path).filter(findings)
+        assert remaining == [] and suppressed == 2
+
+    def test_matching_is_count_aware(self, tmp_path):
+        findings = findings_for("conc003_lock.py")
+        path = tmp_path / "baseline.json"
+        Baseline.write(path, findings[:1])  # baseline only one of two
+        remaining, suppressed = Baseline.load(path).filter(findings)
+        assert suppressed == 1
+        assert [f.line for f in remaining] == [21]
+
+    def test_matching_survives_line_drift(self, tmp_path):
+        findings = findings_for("conc002_poll.py")
+        path = tmp_path / "baseline.json"
+        Baseline.write(path, findings)
+        # same file with lines inserted above the finding: still suppressed
+        shifted = "\n\n\n" + (FIXTURES / "conc002_poll.py").read_text()
+        moved = analyze_source(shifted, "conc002_poll.py")
+        assert moved[0].line == findings[0].line + 3
+        remaining, suppressed = Baseline.load(path).filter(moved)
+        assert remaining == [] and suppressed == 1
+
+    def test_rewrite_preserves_justifications(self, tmp_path):
+        """--write-baseline refresh must not clobber curated entries."""
+        import json as _json
+
+        findings = findings_for("conc003_lock.py")
+        path = tmp_path / "baseline.json"
+        Baseline.write(path, findings)
+        data = _json.loads(path.read_text())
+        data["entries"][0]["justification"] = "reviewed: held by caller"
+        path.write_text(_json.dumps(data))
+        Baseline.write(path, findings)  # refresh with same findings
+        just = [e.justification for e in Baseline.load(path).entries]
+        assert "reviewed: held by caller" in just
+        assert sum(j.startswith("TODO") for j in just) == 1  # only the new one
+
+    def test_synthetic_engine_findings_never_baselined(self, tmp_path):
+        """An unresolvable-engine finding has no source line; baselining it
+        would suppress EVERY future failure of the same kind."""
+        from predictionio_tpu.analysis.contract import check_engine_contract
+
+        fs = check_engine_contract("no_such_engine_xyz")
+        path = tmp_path / "baseline.json"
+        assert Baseline.write(path, fs) == 0
+        remaining, suppressed = Baseline.load(path).filter(fs)
+        assert suppressed == 0 and len(remaining) == 1
+
+    def test_function_local_import_aliases_do_not_leak(self):
+        """`from time import sleep` inside one function must not make a
+        bare sleep() in another function resolve to time.sleep."""
+        src = (
+            "def a():\n"
+            "    from time import sleep\n"
+            "    return sleep\n"
+            "def b(sleep, q):\n"
+            "    while q.busy:\n"
+            "        sleep(0.01)\n"  # parameter, not time.sleep
+        )
+        assert analyze_source(src) == []
+        # module-level import under try/ still resolves
+        src2 = (
+            "try:\n"
+            "    from time import sleep\n"
+            "except ImportError:\n"
+            "    sleep = None\n"
+            "def b(q):\n"
+            "    while q.busy:\n"
+            "        sleep(0.01)\n"
+        )
+        assert [f.rule for f in analyze_source(src2)] == ["PIO-CONC002"]
+
+    def test_malformed_baseline_raises(self, tmp_path):
+        from predictionio_tpu.analysis import BaselineError
+
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        with pytest.raises(BaselineError):
+            Baseline.load(bad)
+        bad.write_text('{"no_entries": true}')
+        with pytest.raises(BaselineError):
+            Baseline.load(bad)
+
+
+class TestSeverityFilter:
+    def test_threshold(self):
+        findings = findings_for("jax001_sync.py") + findings_for(
+            "conc002_poll.py"
+        )
+        assert len(filter_severity(findings, Severity.LOW)) == 4
+        assert len(filter_severity(findings, Severity.MEDIUM)) == 4
+        assert [f.rule for f in filter_severity(findings, Severity.HIGH)] == [
+            "PIO-CONC002"
+        ]
+
+    def test_parse(self):
+        assert Severity.parse("HIGH") is Severity.HIGH
+        assert Severity.parse("medium") is Severity.MEDIUM
+        with pytest.raises(ValueError):
+            Severity.parse("urgent")
+
+
+class TestCheckCLI:
+    """Exit-code contract: 0 clean, 1 findings, 2 usage/parse error —
+    honored in both text and --format json modes."""
+
+    def _clean_file(self, tmp_path) -> Path:
+        p = tmp_path / "clean.py"
+        p.write_text("def f():\n    return 1\n")
+        return p
+
+    def test_exit_0_clean_text_and_json(self, tmp_path, capsys, monkeypatch):
+        monkeypatch.chdir(tmp_path)  # no repo baseline auto-discovery
+        p = self._clean_file(tmp_path)
+        assert cli_main(["check", str(p)]) == 0
+        assert "0 finding(s)" in capsys.readouterr().out
+        assert cli_main(["check", str(p), "--format", "json"]) == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["findings"] == [] and data["summary"]["total"] == 0
+
+    def test_exit_1_findings_text_and_json(self, tmp_path, capsys, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        target = str(FIXTURES / "conc002_poll.py")
+        assert cli_main(["check", target]) == 1
+        out = capsys.readouterr().out
+        assert "PIO-CONC002" in out and ":7:" in out
+        assert cli_main(["check", target, "--format", "json"]) == 1
+        data = json.loads(capsys.readouterr().out)
+        assert [f["rule"] for f in data["findings"]] == ["PIO-CONC002"]
+        assert data["findings"][0]["line"] == 7
+
+    def test_exit_2_on_missing_path(self, tmp_path, capsys, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        assert cli_main(["check", str(tmp_path / "nope")]) == 2
+        assert "usage error" in capsys.readouterr().err
+
+    def test_exit_2_on_unparseable_file(self, tmp_path, capsys, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        bad = tmp_path / "bad.py"
+        bad.write_text("def broken(:\n")
+        assert cli_main(["check", str(bad)]) == 2
+        assert "SyntaxError" in capsys.readouterr().out
+        assert cli_main(["check", str(bad), "--format", "json"]) == 2
+        data = json.loads(capsys.readouterr().out)
+        assert data["errors"]
+
+    def test_exit_2_on_bad_severity(self, tmp_path, capsys, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        assert (
+            cli_main(["check", str(self._clean_file(tmp_path)), "--severity", "nah"])
+            == 2
+        )
+
+    def test_exit_2_on_bad_baseline(self, tmp_path, capsys, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        bad = tmp_path / "b.json"
+        bad.write_text("[]")
+        assert (
+            cli_main(
+                [
+                    "check",
+                    str(FIXTURES / "conc002_poll.py"),
+                    "--baseline",
+                    str(bad),
+                ]
+            )
+            == 2
+        )
+
+    def test_severity_threshold_flag(self, tmp_path, capsys, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        target = str(FIXTURES / "jax001_sync.py")  # mediums only
+        assert cli_main(["check", target]) == 1
+        capsys.readouterr()
+        assert cli_main(["check", target, "--severity", "high"]) == 0
+
+    def test_write_baseline_then_clean(self, tmp_path, capsys, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        target = str(FIXTURES / "conc003_lock.py")
+        bl = str(tmp_path / "bl.json")
+        assert cli_main(["check", target, "--baseline", bl, "--write-baseline"]) == 0
+        assert "2 baseline entries" in capsys.readouterr().out
+        assert cli_main(["check", target, "--baseline", bl]) == 0
+        assert ", 2 suppressed" in capsys.readouterr().out
+
+    def test_write_baseline_refuses_on_parse_error(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        """An incomplete snapshot is worse than none: --write-baseline must
+        exit 2 when any scanned file fails to parse."""
+        monkeypatch.chdir(tmp_path)
+        (tmp_path / "bad.py").write_text("def broken(:\n")
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        assert cli_main(["check", str(tmp_path), "--write-baseline"]) == 2
+        assert "refusing" in capsys.readouterr().err
+        assert not (tmp_path / ".pio-check-baseline.json").exists()
+
+    def test_write_baseline_ignores_severity_filter(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        """The written baseline must be complete (all severities), or the
+        next default-threshold run reports the filtered ones as new."""
+        monkeypatch.chdir(tmp_path)
+        target = str(FIXTURES / "jax001_sync.py")  # medium findings only
+        bl = str(tmp_path / "bl.json")
+        assert (
+            cli_main(
+                [
+                    "check", target, "--severity", "high",
+                    "--baseline", bl, "--write-baseline",
+                ]
+            )
+            == 0
+        )
+        assert "3 baseline entries" in capsys.readouterr().out
+        assert cli_main(["check", target, "--baseline", bl]) == 0
+
+    def test_default_baseline_autodiscovery(self, tmp_path, capsys, monkeypatch):
+        from predictionio_tpu.analysis import DEFAULT_BASELINE_NAME
+
+        monkeypatch.chdir(tmp_path)
+        target = str(FIXTURES / "conc002_poll.py")
+        assert cli_main(["check", target, "--write-baseline"]) == 0
+        assert (tmp_path / DEFAULT_BASELINE_NAME).exists()
+        capsys.readouterr()
+        assert cli_main(["check", target]) == 0  # picked up from cwd
+
+    def test_scan_root_under_skip_named_dir_still_scans(self, tmp_path):
+        """A repo living UNDER a directory named venv/ must scan normally;
+        only skip-dirs nested inside the scanned tree are pruned."""
+        repo = tmp_path / "venv" / "repo"
+        (repo / "node_modules").mkdir(parents=True)
+        (repo / "src").mkdir()
+        (repo / "src" / "poll.py").write_text(
+            "import time\n"
+            "def w(x):\n"
+            "    while not x.done:\n"
+            "        time.sleep(1)\n"
+        )
+        (repo / "node_modules" / "skipme.py").write_text(
+            "import time\n"
+            "def w(x):\n"
+            "    while not x.done:\n"
+            "        time.sleep(1)\n"
+        )
+        report = analyze_paths([repo], root=repo)
+        assert report.files_scanned == 1  # src scanned, node_modules pruned
+        assert [f.rule for f in report.findings] == ["PIO-CONC002"]
+
+    def test_help_documents_exit_codes(self, capsys):
+        with pytest.raises(SystemExit) as e:
+            cli_main(["check", "--help"])
+        assert e.value.code == 0
+        out = capsys.readouterr().out
+        assert "0 = clean" in out and "1 = findings" in out
+        assert "2 = usage or parse error" in out
+
+    def test_unknown_flag_exits_2(self, capsys):
+        with pytest.raises(SystemExit) as e:
+            cli_main(["check", "--bogus"])
+        assert e.value.code == 2
+
+
+# -- DASE contract checks ----------------------------------------------------
+
+
+def _broken_components():
+    """Deliberately mis-wired DASE components for contract tests."""
+    from dataclasses import dataclass
+
+    from predictionio_tpu.core.base import (
+        Algorithm,
+        DataSource,
+        EngineContext,
+        Preparator,
+        Serving,
+    )
+
+    class BadArityDataSource(DataSource):
+        def read_training(self):  # missing ctx
+            return []
+
+    class AbstractAlgorithm(Algorithm):  # predict never implemented
+        def train(self, ctx, pd):
+            return None
+
+    @dataclass(frozen=True)
+    class AliasTypoParams:
+        rank: int = 8
+        params_aliases = {"numFactors": "rankk"}  # typo: no such field
+
+    class AliasTypoAlgorithm(Algorithm):
+        params_class = AliasTypoParams
+
+        def __init__(self, params=None):
+            self.params = params or AliasTypoParams()
+
+        def train(self, ctx, pd):
+            return pd
+
+        def predict(self, model, query):
+            return query
+
+    class NotAServing(Preparator):  # wrong DASE slot
+        def prepare(self, ctx, td):
+            return td
+
+    return (
+        BadArityDataSource,
+        AbstractAlgorithm,
+        AliasTypoAlgorithm,
+        NotAServing,
+    )
+
+
+class TestDaseContract:
+    def test_bundled_engines_are_clean(self):
+        from predictionio_tpu.analysis.contract import check_engine_contract
+        from predictionio_tpu.core.engine import engine_registry
+        from predictionio_tpu.tools.cli import _load_engine_modules
+
+        _load_engine_modules()
+        for name in engine_registry.names():
+            assert check_engine_contract(name) == [], name
+
+    def test_bad_arity_reported(self):
+        from predictionio_tpu.analysis.contract import check_component
+
+        bad_ds, _, _, _ = _broken_components()
+        rules = [f.rule for f in check_component("datasource", "ds", bad_ds)]
+        assert "PIO-DASE002" in rules
+
+    def test_abstract_component_reported(self):
+        from predictionio_tpu.analysis.contract import check_component
+
+        _, abstract_algo, _, _ = _broken_components()
+        fs = list(check_component("algorithm", "a", abstract_algo))
+        assert any(
+            f.rule == "PIO-DASE001" and "predict" in f.message for f in fs
+        )
+
+    def test_params_alias_typo_reported(self):
+        from predictionio_tpu.analysis.contract import check_component
+
+        _, _, alias_typo, _ = _broken_components()
+        fs = list(check_component("algorithm", "a", alias_typo))
+        assert any(
+            f.rule == "PIO-DASE003" and "rankk" in f.message for f in fs
+        )
+
+    def test_wrong_slot_reported(self):
+        from predictionio_tpu.analysis.contract import check_component
+
+        _, _, _, not_a_serving = _broken_components()
+        fs = list(check_component("serving", "s", not_a_serving))
+        assert any(
+            f.rule == "PIO-DASE001" and "wrong" in f.message for f in fs
+        )
+
+    def test_unresolvable_factory_reported(self):
+        from predictionio_tpu.analysis.contract import check_engine_contract
+
+        fs = check_engine_contract("definitely_not_registered")
+        assert [f.rule for f in fs] == ["PIO-DASE001"]
+        assert all(f.severity is Severity.HIGH for f in fs)
+
+    def test_factory_module_crash_becomes_finding(self, tmp_path, monkeypatch):
+        """An import-path factory whose module raises at import must become
+        a PIO-DASE001 finding, not a pio check crash."""
+        from predictionio_tpu.analysis.contract import check_engine_contract
+
+        (tmp_path / "crashy_engine_mod.py").write_text(
+            "raise RuntimeError('config missing')\n"
+        )
+        monkeypatch.syspath_prepend(str(tmp_path))
+        fs = check_engine_contract("crashy_engine_mod:factory")
+        assert [f.rule for f in fs] == ["PIO-DASE001"]
+        assert "not resolvable" in fs[0].message
+
+    def test_check_engine_cli_verb(self, capsys):
+        assert cli_main(["check", "--engine", "classification"]) == 0
+        capsys.readouterr()
+        assert cli_main(["check", "--engine", "no_such_engine"]) == 1
+        assert "PIO-DASE001" in capsys.readouterr().out
+
+    def test_engine_all_combines_with_named(self, capsys):
+        """'all' expands to the bundled engines even when another --engine
+        flag is also given (it must not be treated as a factory name)."""
+        assert (
+            cli_main(
+                ["check", "--engine", "all", "--engine", "classification"]
+            )
+            == 0
+        )
+        assert "'all'" not in capsys.readouterr().out
+
+
+class TestPreflight:
+    """`pio train`/`pio deploy` abort on contract violations before any
+    device work; --no-check skips the gate."""
+
+    @pytest.fixture()
+    def global_storage(self, storage, monkeypatch):
+        import predictionio_tpu.data.storage.config as config_mod
+
+        monkeypatch.setattr(config_mod, "_runtime", storage)
+        return storage
+
+    @pytest.fixture()
+    def alias_typo_factory(self):
+        """A factory that trains fine but has a params_aliases typo —
+        pre-flight must catch what runtime would not."""
+        from predictionio_tpu.core.engine import Engine, engine_registry
+        from sample_engine import DataSource0, Preparator0, Serving0
+
+        _, _, alias_typo, _ = _broken_components()
+
+        def factory():
+            return Engine(DataSource0, Preparator0, alias_typo, Serving0)
+
+        engine_registry.register("_test_alias_typo", factory)
+        yield "_test_alias_typo"
+        engine_registry._entries.pop("_test_alias_typo", None)
+
+    def test_train_aborts_on_contract_violation(
+        self, global_storage, alias_typo_factory, capsys
+    ):
+        assert cli_main(["train", "--engine", alias_typo_factory]) == 1
+        err = capsys.readouterr().err
+        assert "PIO-DASE003" in err and "--no-check" in err
+
+    def test_train_no_check_skips_preflight(
+        self, global_storage, alias_typo_factory, capsys
+    ):
+        assert (
+            cli_main(["train", "--engine", alias_typo_factory, "--no-check"])
+            == 0
+        )
+        assert "Training completed" in capsys.readouterr().out
+
+    def test_deploy_preflight_aborts(self, global_storage, capsys, monkeypatch):
+        from predictionio_tpu.core.engine import Engine, engine_registry
+
+        _, abstract_algo, _, _ = _broken_components()
+        from sample_engine import DataSource0, Preparator0, Serving0
+
+        engine_registry.register(
+            "_test_abstract",
+            lambda: Engine(DataSource0, Preparator0, abstract_algo, Serving0),
+        )
+        try:
+            assert cli_main(["deploy", "--engine", "_test_abstract"]) == 1
+            assert "PIO-DASE001" in capsys.readouterr().err
+        finally:
+            engine_registry._entries.pop("_test_abstract", None)

@@ -1,0 +1,102 @@
+"""CI self-gate: the analyzer turned on its own codebase.
+
+`pio check predictionio_tpu/` must run clean against the checked-in
+baseline (`.pio-check-baseline.json`): any NEW finding — at any severity —
+fails this test, so a regression like reintroducing the microbatch
+busy-wait (PIO-CONC002) or an unlocked write to guarded state
+(PIO-CONC003) is caught in tier-1, not in production.  Baseline entries
+must carry real justifications, and the baseline must not accumulate
+stale entries for code that no longer trips a rule.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from pathlib import Path
+
+from predictionio_tpu.analysis import (
+    Baseline,
+    Severity,
+    analyze_paths,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+PACKAGE = REPO_ROOT / "predictionio_tpu"
+BASELINE = REPO_ROOT / ".pio-check-baseline.json"
+
+
+def _report():
+    return analyze_paths([PACKAGE], root=REPO_ROOT)
+
+
+def test_package_parses_clean():
+    report = _report()
+    assert report.errors == []
+    assert report.files_scanned > 50  # sanity: the walk found the package
+
+
+def test_no_unbaselined_findings():
+    """The acceptance gate: zero non-baselined findings at ANY severity."""
+    report = _report()
+    remaining, _ = Baseline.load(BASELINE).filter(report.findings)
+    highs = [f for f in remaining if f.severity >= Severity.HIGH]
+    assert highs == [], "new HIGH findings:\n" + "\n".join(
+        f.text() for f in highs
+    )
+    assert remaining == [], "new findings (fix or baseline with " \
+        "justification):\n" + "\n".join(f.text() for f in remaining)
+
+
+def test_baseline_entries_are_justified():
+    baseline = Baseline.load(BASELINE)
+    assert baseline.entries, "self-run produced findings; baseline missing?"
+    for e in baseline.entries:
+        assert e.justification.strip(), f"unjustified baseline entry: {e}"
+        assert not e.justification.lower().startswith("todo"), (
+            f"placeholder justification: {e}"
+        )
+
+
+def test_baseline_has_no_stale_entries():
+    """Every baseline entry still matches a real finding — entries for
+    since-fixed code must be deleted, not accumulate."""
+    report = _report()
+    live = Counter((f.rule, f.file, f.source) for f in report.findings)
+    stale = [e for e in Baseline.load(BASELINE).entries if not live[e.key]]
+    assert stale == [], "stale baseline entries:\n" + "\n".join(
+        f"{e.file}: {e.rule}: {e.source}" for e in stale
+    )
+
+
+def test_busy_wait_fix_stays_fixed():
+    """Regression anchor for the defect the first self-run surfaced: the
+    10 ms polling loop in MicroBatcher.close() (server/microbatch.py).  The
+    file must stay free of PIO-CONC002 without any suppression."""
+    report = analyze_paths(
+        [PACKAGE / "server" / "microbatch.py"], root=REPO_ROOT
+    )
+    assert [f for f in report.findings if f.rule == "PIO-CONC002"] == []
+    assert report.pragma_suppressed == 0
+
+
+def test_bundled_engine_contracts_gate():
+    """DASE pre-flight part of the gate: every bundled engine factory
+    passes the contract check."""
+    from predictionio_tpu.analysis.contract import check_engine_contract
+    from predictionio_tpu.core.engine import engine_registry
+    from predictionio_tpu.tools.cli import _load_engine_modules
+
+    _load_engine_modules()
+    names = engine_registry.names()
+    assert set(names) >= {
+        "classification",
+        "ecommerce",
+        "ncf",
+        "recommendation",
+        "similarproduct",
+    }
+    for name in names:
+        findings = check_engine_contract(name)
+        assert findings == [], f"{name}:\n" + "\n".join(
+            f.text() for f in findings
+        )

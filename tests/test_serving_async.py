@@ -133,6 +133,101 @@ class TestMicroBatcher:
 
         asyncio.run(run())
 
+    def test_close_wakes_on_wave_end_without_polling(self):
+        """Regression (pio check PIO-CONC002): close() used to poll
+        _in_wave at a 10 ms interval; it now sleeps on the condition and
+        the worker notifies at end of wave, so wakeup is immediate and the
+        drain-timeout counter stays untouched."""
+        from predictionio_tpu.obs.metrics import MetricsRegistry
+
+        release = threading.Event()
+
+        def batch_fn(items):
+            release.wait(2)
+            return list(items)
+
+        reg = MetricsRegistry()
+
+        async def run():
+            b = MicroBatcher(batch_fn, drain_timeout_s=10.0, registry=reg)
+            fut = asyncio.ensure_future(b.submit(1))
+            await asyncio.sleep(0.05)  # wave in flight, held on `release`
+            loop = asyncio.get_running_loop()
+            close_task = loop.run_in_executor(None, b.close)
+            await asyncio.sleep(0.05)  # close() is now waiting on the cond
+            t0 = time.perf_counter()
+            release.set()
+            await close_task
+            waited = time.perf_counter() - t0
+            assert await fut == 1
+            return waited
+
+        waited = asyncio.run(run())
+        # condition wakeup, not a 10s drain deadline; generous CI slack
+        assert waited < 1.0
+        assert reg.get("pio_microbatch_drain_timeout_total").labels().value == 0
+
+    def test_close_drain_timeout_still_bounded(self):
+        """A wedged batch_fn must not hang close() past drain_timeout_s,
+        and the timeout counter must record the abandonment."""
+        from predictionio_tpu.obs.metrics import MetricsRegistry
+
+        hang = threading.Event()
+
+        def batch_fn(items):
+            hang.wait(5)
+            return list(items)
+
+        reg = MetricsRegistry()
+
+        async def run():
+            b = MicroBatcher(batch_fn, drain_timeout_s=0.1, registry=reg)
+            fut = asyncio.ensure_future(b.submit(1))
+            await asyncio.sleep(0.05)
+            t0 = time.perf_counter()
+            await asyncio.get_running_loop().run_in_executor(None, b.close)
+            elapsed = time.perf_counter() - t0
+            hang.set()  # release the abandoned daemon worker
+            fut.cancel()
+            return elapsed
+
+        elapsed = asyncio.run(run())
+        assert elapsed < 2.0  # bounded by drain_timeout_s, not by batch_fn
+        assert reg.get("pio_microbatch_drain_timeout_total").labels().value == 1
+
+    def test_wave_histogram_snapshot_under_load(self):
+        """Regression for the unlocked wave_sizes write: wave_histogram()
+        snapshots under the worker's condition while waves are landing, and
+        the final histogram accounts for every submitted item."""
+        stop = threading.Event()
+        errors: list[BaseException] = []
+
+        def batch_fn(items):
+            return list(items)
+
+        async def run():
+            b = MicroBatcher(batch_fn, max_batch=8)
+
+            def reader():
+                try:
+                    while not stop.is_set():
+                        for size, n in b.wave_histogram().items():
+                            assert size > 0 and n > 0
+                except BaseException as e:  # pragma: no cover - fail signal
+                    errors.append(e)
+
+            t = threading.Thread(target=reader, daemon=True)
+            t.start()
+            for _ in range(50):
+                await asyncio.gather(*(b.submit(i) for i in range(8)))
+            stop.set()
+            t.join(timeout=2)
+            return b
+
+        b = asyncio.run(run())
+        assert not errors
+        assert sum(size * n for size, n in b.wave_histogram().items()) == 400
+
 
 class TestPredictionServerPluginRoutes:
     """/plugins* on the engine server (CreateServer.scala:656-702)."""
