@@ -24,6 +24,10 @@ from predictionio_tpu.core.engine import Engine, EngineParams
 from predictionio_tpu.core.persistence import save_models
 from predictionio_tpu.data.storage.base import EngineInstance, EvaluationInstance
 from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
+from predictionio_tpu.obs.logging import (
+    reset_request_context,
+    set_request_context,
+)
 from predictionio_tpu.obs.metrics import REGISTRY
 from predictionio_tpu.obs.tracing import install_jax_compile_listener, trace
 
@@ -108,6 +112,10 @@ def run_train(
     # pio_jax_compile_seconds alongside the stage spans
     install_jax_compile_listener()
     compile_s0 = _compile_seconds()
+    # bind the engine-instance id as the run's correlation id: every log
+    # line and span this training run emits carries request_id=<instance>,
+    # the same correlation contract the serving path uses per query
+    ctx_tokens = set_request_context(instance.id)
     try:
         with trace("workflow.run_train") as root:
             algos, models = engine.train_full(
@@ -148,13 +156,16 @@ def run_train(
                 save_models(storage.models(), instance.id, stored)
         done = instance.completed()
         instances.update(done)
-        log.info("training finished: engine instance %s", instance.id)
+        breakdown = _stage_breakdown(root, _compile_seconds() - compile_s0)
+        log.info(
+            "training finished: engine instance %s",
+            instance.id,
+            extra={"engine_instance": instance.id, "engine_id": engine_id},
+        )
         log.info(
             "DASE stage breakdown: %s",
-            json.dumps(
-                _stage_breakdown(root, _compile_seconds() - compile_s0),
-                sort_keys=True,
-            ),
+            json.dumps(breakdown, sort_keys=True),
+            extra={"engine_instance": instance.id, "stages": breakdown},
         )
         return done
     except Exception:
@@ -163,8 +174,14 @@ def run_train(
         instances.update(
             _dc.replace(instance, status="FAILED", end_time=_now())
         )
+        log.error(
+            "training FAILED: engine instance %s",
+            instance.id,
+            extra={"engine_instance": instance.id, "engine_id": engine_id},
+        )
         raise
     finally:
+        reset_request_context(ctx_tokens)
         from predictionio_tpu.core.cleanup import run as _run_cleanups
 
         _run_cleanups()

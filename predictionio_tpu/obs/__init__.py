@@ -1,16 +1,34 @@
-"""Observability: metrics registry, per-stage tracing, exposition.
+"""Observability: metrics, tracing, structured logs, flight recorder, SLO.
 
 The subsystem every later perf PR leans on — counters/gauges/log-bucketed
 histograms (metrics.py), context-manager spans with a recent-trace ring
-(tracing.py), Prometheus + JSON HTTP exposition (http.py), and a sniffer
-plugin proving the plugin seams can consume the registry (plugin.py).
-Dependency-free; the process-global default registry is ``REGISTRY``.
+(tracing.py), request-id-correlated JSON-lines logging with an in-process
+ring (logging.py), a flight recorder for the slowest/errored requests
+(flight.py), rolling-window SLO tracking with burn rates + health routes
+(slo.py), on-demand jax.profiler capture (profiler.py), HTTP exposition for
+all of it (http.py), and a sniffer plugin proving the plugin seams can
+consume the registry (plugin.py).  Dependency-free; the process-global
+default registry is ``REGISTRY``.
 """
 
+from predictionio_tpu.obs.flight import FLIGHT, FlightRecorder, annotate
+from predictionio_tpu.obs.logging import (
+    REQUEST_ID_HEADER,
+    JsonLineFormatter,
+    LogRing,
+    configure_logging,
+    get_log_ring,
+    get_request_id,
+    new_request_id,
+    reset_request_context,
+    set_request_context,
+)
 from predictionio_tpu.obs.metrics import (
     LATENCY_BUCKETS,
     REGISTRY,
     SIZE_BUCKETS,
+    STAGE_BUCKETS,
+    TRAIN_BUCKETS,
     Counter,
     Gauge,
     Histogram,
@@ -18,6 +36,8 @@ from predictionio_tpu.obs.metrics import (
     default_registry,
     quantile_from_buckets,
 )
+from predictionio_tpu.obs.profiler import PROFILER, sample_runtime_gauges
+from predictionio_tpu.obs.slo import SLOTracker
 from predictionio_tpu.obs.tracing import (
     Span,
     clear_traces,
@@ -29,20 +49,37 @@ from predictionio_tpu.obs.tracing import (
 )
 
 __all__ = [
+    "FLIGHT",
+    "FlightRecorder",
+    "JsonLineFormatter",
     "LATENCY_BUCKETS",
+    "LogRing",
+    "PROFILER",
     "REGISTRY",
+    "REQUEST_ID_HEADER",
     "SIZE_BUCKETS",
+    "SLOTracker",
+    "STAGE_BUCKETS",
+    "TRAIN_BUCKETS",
     "Counter",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "Span",
+    "annotate",
     "clear_traces",
+    "configure_logging",
     "current_span",
     "default_registry",
+    "get_log_ring",
+    "get_request_id",
     "install_jax_compile_listener",
+    "new_request_id",
     "observe_span",
     "quantile_from_buckets",
     "recent_traces",
+    "reset_request_context",
+    "sample_runtime_gauges",
+    "set_request_context",
     "trace",
 ]

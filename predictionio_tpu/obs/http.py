@@ -1,48 +1,191 @@
-"""Metrics exposition routes for any :class:`HTTPApp`.
+"""Observability routes for any :class:`HTTPApp`.
 
-``add_metrics_routes(app)`` wires the standard three endpoints onto a server:
+``add_observability_routes(app)`` wires the full request-lifecycle surface
+onto a server:
 
-  GET /metrics        Prometheus text format 0.0.4
-  GET /metrics.json   the JSON shape (adds p50/p95/p99 per histogram series)
-  GET /traces.json    recent finished root spans (ring buffer)
+  GET  /metrics             Prometheus text format 0.0.4 (runtime gauges are
+                            re-sampled on each scrape)
+  GET  /metrics.json        the JSON shape (adds p50/p95/p99 per histogram)
+  GET  /traces.json         recent finished root spans (ring buffer)
+  GET  /logs.json           recent structured log records (?request_id=&
+                            limit=&level=)
+  GET  /debug/flight.json   flight recorder: N slowest + errored requests
+  POST /debug/profile       start a jax.profiler capture (?seconds=N&dir=)
+  GET  /debug/profile       capture status (running / last)
+  GET  /healthz             liveness — ALWAYS ungated (load balancers carry
+                            no keys); advisory SLO status rides along
+  GET  /readyz              readiness checks (model loaded, stores up, ...)
+  GET  /slo.json            rolling-window SLO + burn rates
 
-Every server (prediction :8000, event :7070, admin :7071, dashboard :9000)
-calls this so one scrape config covers the fleet.  Apps constructed with an
-``access_key`` gate these routes like everything else on that app.
+Auth: pass ``access_key`` to gate everything here except ``/healthz``; apps
+with an app-level ``HTTPApp(access_key=...)`` gate these like every other
+route, with ``/healthz`` registered as a public route that bypasses the
+app-level key.  ``POST /debug/profile`` additionally REQUIRES some key to be
+configured (route-level or app-level) — an anonymous client must never be
+able to arm the profiler.
+
+Both HTTP front ends call :func:`record_request_outcome` after each request
+to feed the per-app SLO tracker and flight recorder (observability routes
+themselves are excluded so scrapes and probes don't pollute the SLO window).
 """
 
 from __future__ import annotations
 
+import json
+from typing import Any, Callable, Mapping
+
+from predictionio_tpu.obs.flight import FlightRecorder, current_annotations
+from predictionio_tpu.obs.logging import get_log_ring
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from predictionio_tpu.obs.profiler import (
+    PROFILER,
+    ProfilerBusy,
+    ProfilerUnsupported,
+    sample_runtime_gauges,
+)
+from predictionio_tpu.obs.slo import SLOTracker, run_readiness
 from predictionio_tpu.obs.tracing import recent_traces
 
 #: Prometheus text exposition content type.
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+#: observability/probe paths excluded from SLO + flight accounting
+_OBS_PATHS = frozenset(
+    (
+        "/metrics",
+        "/metrics.json",
+        "/traces.json",
+        "/logs.json",
+        "/healthz",
+        "/readyz",
+        "/slo.json",
+    )
+)
 
-def add_metrics_routes(app, registry: MetricsRegistry | None = None):
-    """Register /metrics, /metrics.json, and /traces.json on ``app``."""
+
+def is_observability_path(path: str) -> bool:
+    return path in _OBS_PATHS or path.startswith("/debug/")
+
+
+def record_request_outcome(app, req, resp, duration_s: float, span) -> None:
+    """Feed the app's SLO tracker and flight recorder with one finished
+    request.  Called by both HTTP front ends; cheap no-op for apps without
+    observability routes and for the observability routes themselves."""
+    if is_observability_path(req.path):
+        return
+    slo: SLOTracker | None = getattr(app, "slo", None)
+    if slo is not None:
+        slo.record(resp.status < 500, duration_s)
+    flight: FlightRecorder | None = getattr(app, "flight", None)
+    if flight is None:
+        return
+    if resp.status < 500 and not flight.would_retain(duration_s):
+        return  # fast path: skip span serialization for unremarkable wins
+    entry: dict[str, Any] = {
+        "request_id": span.request_id,
+        "server": app.name,
+        "method": req.method,
+        "path": req.path,
+        "status": resp.status,
+        "duration_s": round(duration_s, 6),
+        "payload_bytes": len(req.body or b""),
+        "response_bytes": len(resp.encoded()[0]),
+        "span": span.to_dict(),
+    }
+    ann = current_annotations()
+    if ann:
+        entry.update(ann)
+    if resp.status >= 500:
+        try:
+            body = resp.body
+            message = (
+                body.get("message") if isinstance(body, dict) else None
+            )
+            entry["error"] = str(message if message is not None else body)[
+                :500
+            ]
+        except Exception:
+            entry["error"] = "unrenderable error body"
+    flight.record(entry)
+
+
+def add_observability_routes(
+    app,
+    registry: MetricsRegistry | None = None,
+    access_key: str | None = None,
+    readiness: Mapping[str, Callable[[], bool]] | None = None,
+    slo: SLOTracker | None = None,
+    flight: FlightRecorder | None = None,
+    debug_routes: bool = True,
+):
+    """The full observability surface: metrics + logs + flight + profiler +
+    health.  Installs ``app.slo`` / ``app.flight`` / ``app.readiness`` so
+    the HTTP front ends (and the dashboard's Health panel) can reach them.
+
+    ``access_key`` gates every route here EXCEPT ``/healthz`` — on apps
+    whose ``HTTPApp(access_key=...)`` already gates globally, ``/healthz``
+    is registered public so load balancers can always probe liveness.
+
+    ``debug_routes=False`` skips /logs.json, /debug/flight.json, and
+    /debug/profile entirely: servers that must stay open to anonymous
+    clients (the event server's ingest port) expose the scrape surface but
+    not log contents, error bodies, or an anonymous profiler trigger.
+    """
     from predictionio_tpu.server.httpd import (
         Request,
         Response,
+        error_response,
         json_response,
+        key_matches,
     )
 
     reg = registry or REGISTRY
+    app.slo = slo or SLOTracker()
+    # no flight recorder without its route: the event server's ingest path
+    # must not pay per-request entry construction for records nothing serves
+    app.flight = (flight or FlightRecorder()) if debug_routes else None
+    app.readiness = dict(readiness or {})
+    ring = get_log_ring()
 
-    @app.route("GET", "/metrics")
+    original_route = app.route
+
+    if access_key is not None:
+
+        def route(method: str, pattern: str, public: bool = False):
+            """Wrap handlers with the route-level key check (Bearer header
+            or ?accessKey=), leaving public routes open."""
+            def deco(fn):
+                if public:
+                    return original_route(method, pattern, public=True)(fn)
+
+                def guarded(req: Request) -> Response:
+                    if not key_matches(req, access_key):
+                        return error_response(401, "Invalid accessKey.")
+                    return fn(req)
+
+                return original_route(method, pattern)(guarded)
+
+            return deco
+
+    else:
+        route = original_route
+
+    # -- metrics + traces (gated when a key is configured) -------------------
+    @route("GET", "/metrics")
     def metrics(req: Request) -> Response:
+        sample_runtime_gauges(reg)
         return Response(
             200,
             reg.render_prometheus(),
             content_type=PROMETHEUS_CONTENT_TYPE,
         )
 
-    @app.route("GET", "/metrics\\.json")
+    @route("GET", "/metrics\\.json")
     def metrics_json(req: Request) -> Response:
+        sample_runtime_gauges(reg)
         return json_response(200, reg.render_json())
 
-    @app.route("GET", "/traces\\.json")
+    @route("GET", "/traces\\.json")
     def traces_json(req: Request) -> Response:
         try:
             limit = int(req.query.get("limit", 20))
@@ -52,4 +195,105 @@ def add_metrics_routes(app, registry: MetricsRegistry | None = None):
             200, {"traces": recent_traces(min(max(limit, 0), 256))}
         )
 
+    if not debug_routes:
+        _add_health_routes(app, route)
+        return app
+
+    # -- structured log ring -------------------------------------------------
+    @route("GET", "/logs\\.json")
+    def logs_json(req: Request) -> Response:
+        try:
+            limit = int(req.query.get("limit", 100))
+        except ValueError:
+            return json_response(400, {"message": "limit must be an integer"})
+        records = ring.records(
+            limit=min(max(limit, 0), 1024),
+            request_id=req.query.get("request_id"),
+            min_level=req.query.get("level"),
+        )
+        return Response(
+            200,
+            json.dumps({"logs": records}, default=str),
+            content_type="application/json; charset=utf-8",
+        )
+
+    # -- flight recorder -----------------------------------------------------
+    @route("GET", "/debug/flight\\.json")
+    def flight_json(req: Request) -> Response:
+        limit = None
+        if "limit" in req.query:
+            try:
+                limit = int(req.query["limit"])
+            except ValueError:
+                return json_response(
+                    400, {"message": "limit must be an integer"}
+                )
+        snap = app.flight.snapshot(
+            request_id=req.query.get("request_id"), limit=limit
+        )
+        return Response(
+            200,
+            json.dumps(snap, default=str),
+            content_type="application/json; charset=utf-8",
+        )
+
+    # -- on-demand profiler --------------------------------------------------
+    # arming a capture is privileged even on otherwise-open servers: without
+    # ANY configured key (route-level or app-level), repeated anonymous
+    # 300 s captures are a disk-fill + overhead DoS on the serving port
+    profile_protected = access_key is not None or app.access_key is not None
+
+    @route("POST", "/debug/profile")
+    def profile_start(req: Request) -> Response:
+        if not profile_protected:
+            return json_response(
+                403,
+                {
+                    "message": "profiling requires an access key; start the "
+                    "server with an access key (--accesskey / --access-key "
+                    "/ PIO_OBS_ACCESS_KEY) to enable /debug/profile"
+                },
+            )
+        try:
+            seconds = float(req.query.get("seconds", 5))
+        except ValueError:
+            return json_response(400, {"message": "seconds must be a number"})
+        try:
+            started = PROFILER.start(seconds, req.query.get("dir"))
+        except ValueError as e:
+            return json_response(400, {"message": str(e)})
+        except ProfilerBusy as e:
+            return json_response(409, {"message": str(e)})
+        except ProfilerUnsupported as e:
+            # 501: the verb is understood, the backend can't do it (CPU
+            # wheels without profiler support, missing tensorboard plugin)
+            return json_response(501, {"message": str(e)})
+        return json_response(202, started)
+
+    @route("GET", "/debug/profile")
+    def profile_status(req: Request) -> Response:
+        return json_response(200, PROFILER.status())
+
+    _add_health_routes(app, route)
     return app
+
+
+def _add_health_routes(app, route) -> None:
+    """/healthz (public), /readyz, /slo.json — shared by both the full and
+    the no-debug-routes variants of the observability surface."""
+    from predictionio_tpu.server.httpd import Request, Response, json_response
+
+    @route("GET", "/healthz", public=True)
+    def healthz(req: Request) -> Response:
+        return json_response(200, app.slo.healthz())
+
+    @route("GET", "/readyz")
+    def readyz(req: Request) -> Response:
+        ready, results = run_readiness(app.readiness)
+        return json_response(
+            200 if ready else 503, {"ready": ready, "checks": results}
+        )
+
+    @route("GET", "/slo\\.json")
+    def slo_json(req: Request) -> Response:
+        return json_response(200, app.slo.snapshot())

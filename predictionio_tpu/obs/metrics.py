@@ -35,12 +35,21 @@ LATENCY_BUCKETS: tuple[float, ...] = tuple(
 #: Power-of-two bounds for size-shaped histograms (batch size, queue depth).
 SIZE_BUCKETS: tuple[float, ...] = tuple(float(2**i) for i in range(13))
 
-#: Coarser bounds for second-to-hour-scale stages (DASE train stages, XLA
-#: compiles): 1 ms – 10 000 s, two buckets per decade.  The serving-latency
-#: set tops out at 10 s, which would clamp train-stage quantiles.
+#: Coarser bounds for second-to-hour-scale stages (XLA compiles, long batch
+#: jobs): 1 ms – 10 000 s, two buckets per decade.  The serving-latency set
+#: tops out at 10 s, which would clamp train-stage quantiles.
 STAGE_BUCKETS: tuple[float, ...] = tuple(
     round(10.0 ** (e + f / 2.0), 9) for e in range(-3, 4) for f in range(2)
 ) + (10000.0,)
+
+#: Train/eval span bounds: 100 µs – 600 s.  Bucket bounds are configurable
+#: per histogram family (``buckets=``); this is the set ``pio_span_seconds``
+#: uses, chosen so sub-millisecond eval folds AND 40 s+ train/event-store
+#: stages (BENCH_r05) both keep meaningful quantiles — a range that tops out
+#: at 10 s silently pins a 40 s stage's p99 to 10 s.
+TRAIN_BUCKETS: tuple[float, ...] = tuple(
+    round(10.0 ** (e + f / 2.0), 9) for e in range(-4, 3) for f in range(2)
+) + (600.0,)
 
 
 def _fmt(v: float) -> str:

@@ -2,32 +2,44 @@
 
 ``trace("stage")`` is a context manager that times its block, records the
 duration into the ``pio_span_seconds{span="stage"}`` histogram, and builds a
-parent/child tree through a thread-local span stack — nested ``trace`` blocks
-become children of the enclosing one.  Finished ROOT spans additionally land
-in a bounded ring buffer (:func:`recent_traces`) so "what did the last train
-run spend its time on" is answerable without a metrics backend.
+parent/child tree through a context-local span stack — nested ``trace``
+blocks become children of the enclosing one.  Finished ROOT spans
+additionally land in a bounded ring buffer (:func:`recent_traces`) so "what
+did the last train run spend its time on" is answerable without a metrics
+backend.
 
-This is deliberately not OpenTelemetry: no IDs, no export, no sampling — a
-span is a (name, duration, children) record and one histogram observation.
-The serving hot path uses the registry directly (a span allocation per query
-would be measurable); spans are for the second-scale stages: DASE train
-stages, JAX compiles, batch predict, eval folds.
+This is deliberately not OpenTelemetry: no export, no sampling — a span is a
+(name, duration, children) record and one histogram observation.  Spans DO
+carry the contextvar ``request_id`` (obs/logging.py) when one is bound, so a
+``/traces.json`` entry correlates with the ``X-Pio-Request-Id`` response
+header and the matching ``/logs.json`` lines.  The HTTP front ends open one
+cheap unrecorded root span per request (``record=False``: ring only, no
+histogram); the second-scale stages — DASE train stages, JAX compiles, batch
+predict, eval folds — use recorded spans.
 """
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 from collections import deque
 from typing import Any
 
+from predictionio_tpu.obs.logging import get_request_id
 from predictionio_tpu.obs.metrics import (
     REGISTRY,
     STAGE_BUCKETS,
+    TRAIN_BUCKETS,
     MetricsRegistry,
 )
 
-_tls = threading.local()
+#: the span stack is a ContextVar (not a threading.local) so nesting is
+#: correct both across threads AND across interleaved asyncio tasks — two
+#: concurrent requests on one event loop must not adopt each other's spans
+_stack_var: contextvars.ContextVar[list["Span"] | None] = (
+    contextvars.ContextVar("pio_span_stack", default=None)
+)
 
 #: ring of the most recent finished root spans (as dicts), newest last
 _ring: deque[dict[str, Any]] = deque(maxlen=256)
@@ -37,7 +49,10 @@ _ring_lock = threading.Lock()
 class Span:
     """One timed block.  ``duration_s`` is valid after the block exits."""
 
-    __slots__ = ("name", "start_s", "duration_s", "children", "error")
+    __slots__ = (
+        "name", "start_s", "duration_s", "children", "error",
+        "request_id", "tags",
+    )
 
     def __init__(self, name: str):
         self.name = name
@@ -45,12 +60,21 @@ class Span:
         self.duration_s = 0.0
         self.children: list[Span] = []
         self.error: str | None = None
+        #: correlation id captured from the request context at entry
+        self.request_id: str | None = None
+        #: small free-form annotations (route, status, ...) — keep it small;
+        #: every root span's dict lands in the trace ring
+        self.tags: dict[str, Any] | None = None
 
     def to_dict(self) -> dict[str, Any]:
         d: dict[str, Any] = {
             "name": self.name,
             "duration_s": round(self.duration_s, 9),
         }
+        if self.request_id:
+            d["request_id"] = self.request_id
+        if self.tags:
+            d.update(self.tags)
         if self.error:
             d["error"] = self.error
         if self.children:
@@ -81,10 +105,12 @@ class trace:
         self._record = record
 
     def __enter__(self) -> Span:
-        stack = getattr(_tls, "stack", None)
+        stack = _stack_var.get()
         if stack is None:
-            stack = _tls.stack = []
+            stack = []
+            _stack_var.set(stack)
         stack.append(self.span)
+        self.span.request_id = get_request_id()
         self.span.start_s = time.perf_counter()
         return self.span
 
@@ -92,7 +118,7 @@ class trace:
         self.span.duration_s = time.perf_counter() - self.span.start_s
         if exc is not None:
             self.span.error = f"{type(exc).__name__}: {exc}"
-        stack = _tls.stack
+        stack = _stack_var.get() or []
         stack.pop()
         if stack:
             stack[-1].children.append(self.span)
@@ -104,13 +130,13 @@ class trace:
                 "pio_span_seconds",
                 "Duration of named stages (trace spans)",
                 labelnames=("span",),
-                buckets=STAGE_BUCKETS,
+                buckets=TRAIN_BUCKETS,
             ).labels(self.span.name).observe(self.span.duration_s)
         return None
 
 
 def current_span() -> Span | None:
-    stack = getattr(_tls, "stack", None)
+    stack = _stack_var.get()
     return stack[-1] if stack else None
 
 
@@ -123,7 +149,7 @@ def observe_span(
         "pio_span_seconds",
         "Duration of named stages (trace spans)",
         labelnames=("span",),
-        buckets=STAGE_BUCKETS,
+        buckets=TRAIN_BUCKETS,
     ).labels(name).observe(seconds)
 
 

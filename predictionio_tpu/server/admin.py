@@ -13,7 +13,7 @@ Route parity with tools/admin/AdminAPI.scala:45-109 + CommandClient.scala:61:
 from __future__ import annotations
 
 from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
-from predictionio_tpu.obs.http import add_metrics_routes
+from predictionio_tpu.obs.http import add_observability_routes
 from predictionio_tpu.server.httpd import (
     AppServer,
     HTTPApp,
@@ -40,7 +40,13 @@ def create_admin_app(
     applied to the admin surface); TLS comes from the AppServer layer."""
     storage = storage or get_storage()
     app = HTTPApp("adminserver", access_key=access_key)
-    add_metrics_routes(app)
+
+    def _metadata_ready() -> bool:
+        storage.access_keys().get("__readyz_probe__")
+        return True
+
+    # app-level access_key (when set) gates these; /healthz stays public
+    add_observability_routes(app, readiness={"metadata_store": _metadata_ready})
 
     def describe(d: AppDescription) -> dict:
         return d.to_json_dict()

@@ -16,6 +16,7 @@ tools) and this one (deploy hot path).
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import inspect
 import logging
 import os
@@ -25,12 +26,25 @@ import time
 from typing import Any
 from urllib.parse import parse_qs, urlsplit
 
+from predictionio_tpu.obs.flight import begin_annotations, end_annotations
+from predictionio_tpu.obs.http import (
+    is_observability_path,
+    record_request_outcome,
+)
+from predictionio_tpu.obs.logging import (
+    REQUEST_ID_HEADER,
+    new_request_id,
+    reset_request_context,
+    set_request_context,
+)
 from predictionio_tpu.obs.metrics import REGISTRY
+from predictionio_tpu.obs.tracing import trace
 from predictionio_tpu.server.httpd import (
     HTTPApp,
     Request,
     Response,
     error_response,
+    header_get,
     unquote_groups,
 )
 
@@ -56,10 +70,36 @@ _KNOWN_METHODS = frozenset(
 
 
 async def _handle_app_request(app: HTTPApp, req: Request) -> Response:
-    """Route like HTTPApp.handle, awaiting coroutine handlers and pushing
-    sync handlers to the executor."""
+    """Route like HTTPApp.handle with the request-lifecycle bookkeeping of
+    httpd.observe_request, async-shaped: mint/adopt the request id, bind the
+    logging context, wrap the handler in an unrecorded root span, echo
+    ``X-Pio-Request-Id``, feed SLO + flight.  Observability/probe paths skip
+    the span + accounting so scrapes never pollute the SLO window."""
     t0 = time.perf_counter()
-    resp = await _route_app_request(app, req)
+    rid = header_get(req.headers, REQUEST_ID_HEADER) or new_request_id()
+    if is_observability_path(req.path):
+        resp = await _route_app_request(app, req)
+    else:
+        tokens = set_request_context(rid)
+        ann_token = begin_annotations()
+        try:
+            with trace(f"http.{app.name}", record=False) as span:
+                resp = await _route_app_request(app, req)
+                span.tags = {
+                    "method": req.method,
+                    "path": req.path,
+                    "status": resp.status,
+                }
+            try:
+                record_request_outcome(
+                    app, req, resp, time.perf_counter() - t0, span
+                )
+            except Exception:  # telemetry must never fail the request
+                pass
+        finally:
+            end_annotations(ann_token)
+            reset_request_context(tokens)
+    resp.headers.setdefault(REQUEST_ID_HEADER, rid)
     method = req.method if req.method in _KNOWN_METHODS else "OTHER"
     _m_http.labels(app.name, method, str(resp.status)).observe(
         time.perf_counter() - t0
@@ -68,25 +108,25 @@ async def _handle_app_request(app: HTTPApp, req: Request) -> Response:
 
 
 async def _route_app_request(app: HTTPApp, req: Request) -> Response:
-    path_matched = False
-    for method, pattern, fn in app._routes:
-        m = pattern.match(req.path)
-        if not m:
-            continue
-        path_matched = True
-        if method != req.method:
-            continue
-        req.params = unquote_groups(m)
-        try:
-            if inspect.iscoroutinefunction(fn):
-                return await fn(req)
-            loop = asyncio.get_running_loop()
-            return await loop.run_in_executor(None, fn, req)
-        except Exception as e:
-            return error_response(500, f"{type(e).__name__}: {e}")
-    if path_matched:
-        return error_response(405, "Method Not Allowed")
-    return error_response(404, "Not Found")
+    fn, m, status = app.match(req)
+    denied = app.auth_error(req, fn)
+    if denied is not None:
+        return denied
+    if fn is None:
+        return error_response(
+            status, "Method Not Allowed" if status == 405 else "Not Found"
+        )
+    req.params = unquote_groups(m)
+    try:
+        if inspect.iscoroutinefunction(fn):
+            return await fn(req)
+        loop = asyncio.get_running_loop()
+        # copy_context: run_in_executor does not propagate contextvars, and
+        # sync handlers must still see the request id / annotation scope
+        ctx = contextvars.copy_context()
+        return await loop.run_in_executor(None, ctx.run, fn, req)
+    except Exception as e:
+        return error_response(500, f"{type(e).__name__}: {e}")
 
 
 async def _read_request(reader: asyncio.StreamReader) -> Request | None:

@@ -10,10 +10,13 @@ EvaluationInstance row, CoreWorkflow.scala:144-155).
 from __future__ import annotations
 
 import html
+from urllib.parse import quote
 
 from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
-from predictionio_tpu.obs.http import add_metrics_routes
+from predictionio_tpu.obs.http import add_observability_routes
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from predictionio_tpu.obs.slo import run_readiness
+from predictionio_tpu.obs.tracing import recent_traces
 from predictionio_tpu.server.httpd import (
     AppServer,
     HTTPApp,
@@ -51,6 +54,68 @@ def _metrics_table_html(registry: MetricsRegistry) -> str:
     )
 
 
+def _traces_table_html(n: int = 15, access_key: str | None = None) -> str:
+    """Recent root spans; rows with a request id link to the matching
+    flight-recorder entry for the full per-request record.  On a key-gated
+    dashboard the link carries the accessKey (the Dashboard.scala:47
+    link-parity rationale the query-param transport exists for) so clicking
+    through from an authenticated page doesn't 401."""
+    key_param = f"&accessKey={quote(access_key)}" if access_key else ""
+    rows = []
+    for t in recent_traces(n):
+        rid = t.get("request_id") or ""
+        rid_cell = (
+            f"<a href='/debug/flight.json?request_id={quote(rid)}"
+            f"{key_param}'>{html.escape(rid)}</a>"
+            if rid
+            else ""
+        )
+        children = ", ".join(
+            c.get("name", "") for c in t.get("children", [])
+        )
+        rows.append(
+            f"<tr><td>{html.escape(t.get('name', ''))}</td>"
+            f"<td>{t.get('duration_s', 0):.6f}</td>"
+            f"<td>{rid_cell}</td>"
+            f"<td>{html.escape(t.get('error') or '')}</td>"
+            f"<td>{html.escape(children)}</td></tr>"
+        )
+    return (
+        "<h2>Recent traces</h2><table border='1'>"
+        "<tr><th>span</th><th>seconds</th><th>request</th>"
+        "<th>error</th><th>children</th></tr>"
+        + "".join(rows)
+        + "</table>"
+    )
+
+
+def _health_html(app: HTTPApp) -> str:
+    """SLO window + readiness checks as a panel (the /healthz, /readyz,
+    /slo.json surface, human-shaped)."""
+    slo = app.slo.snapshot()
+    ready, checks = run_readiness(app.readiness)
+    slo_rows = "".join(
+        f"<tr><td>{html.escape(str(k))}</td>"
+        f"<td>{html.escape(str(v))}</td></tr>"
+        for k, v in slo.items()
+    )
+    check_rows = "".join(
+        f"<tr><td>{html.escape(name)}</td>"
+        f"<td>{'ok' if ok else 'FAILING'}</td></tr>"
+        for name, ok in checks.items()
+    )
+    return (
+        f"<h2>Health</h2><p>status: <b>{html.escape(slo['status'])}</b>, "
+        f"ready: <b>{'yes' if ready else 'NO'}</b></p>"
+        "<table border='1'><tr><th>slo</th><th>value</th></tr>"
+        + slo_rows
+        + "</table><table border='1'><tr><th>readiness check</th>"
+        "<th>state</th></tr>"
+        + check_rows
+        + "</table>"
+    )
+
+
 def create_dashboard_app(
     storage: StorageRuntime | None = None, access_key: str | None = None
 ) -> HTTPApp:
@@ -58,7 +123,13 @@ def create_dashboard_app(
     KeyAuthentication); TLS comes from the AppServer layer below."""
     storage = storage or get_storage()
     app = HTTPApp("dashboard", access_key=access_key)
-    add_metrics_routes(app)
+
+    def _metadata_ready() -> bool:
+        storage.evaluation_instances().get_completed()
+        return True
+
+    # app-level access_key (when set) gates these; /healthz stays public
+    add_observability_routes(app, readiness={"metadata_store": _metadata_ready})
 
     @app.route("GET", "/")
     def index(req: Request) -> Response:
@@ -78,7 +149,9 @@ def create_dashboard_app(
             "<h1>Completed evaluations</h1>"
             "<table border='1'><tr><th>id</th><th>evaluation</th>"
             f"<th>started</th><th>finished</th><th>result</th></tr>{rows}"
-            f"</table>{_metrics_table_html(REGISTRY)}</body></html>",
+            f"</table>{_health_html(app)}"
+            f"{_traces_table_html(access_key=access_key)}"
+            f"{_metrics_table_html(REGISTRY)}</body></html>",
         )
 
     @app.route("GET", "/engine_instances/(?P<iid>[^/]+)")

@@ -36,7 +36,7 @@ from predictionio_tpu.data.webhooks import (
     to_event,
 )
 from predictionio_tpu.data.datamap import parse_event_time
-from predictionio_tpu.obs.http import add_metrics_routes
+from predictionio_tpu.obs.http import add_observability_routes
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
 from predictionio_tpu.server.httpd import (
     AppServer,
@@ -96,7 +96,10 @@ def create_event_server_app(
     stats: bool = False,
     plugins: "PluginContext | None" = None,
     registry: MetricsRegistry | None = None,
+    obs_access_key: str | None = None,
 ) -> HTTPApp:
+    import os
+
     from predictionio_tpu.server.plugins import PluginContext
 
     storage = storage or get_storage()
@@ -105,9 +108,35 @@ def create_event_server_app(
     levents = storage.l_events()
     plugins = plugins or PluginContext.from_env()
     registry = registry or REGISTRY
-    # /metrics + /metrics.json: unauthenticated like GET / — scrapers
-    # carry no per-app access keys, and the registry holds no event payloads
-    add_metrics_routes(app, registry)
+
+    def _event_store_ready() -> bool:
+        # live probe, not a captured handle: run_readiness treats a raise
+        # as not-ready, so a backend that dies after startup flips /readyz
+        return storage.l_events() is not None
+
+    def _metadata_ready() -> bool:
+        storage.access_keys().get("__readyz_probe__")
+        return True
+
+    # Without an operator key, only the scrape surface (/metrics,
+    # /traces.json, health) is exposed, unauthenticated like GET / —
+    # scrapers and load balancers carry no per-app access keys, and the
+    # registry holds no event payloads.  The DEBUG surface (/logs.json,
+    # /debug/flight.json, /debug/profile) leaks log lines / error bodies
+    # and arms the profiler, so on this anonymous-facing ingest port it
+    # only exists behind an operator key (``obs_access_key`` or
+    # PIO_OBS_ACCESS_KEY), which then gates everything except /healthz.
+    obs_access_key = obs_access_key or os.environ.get("PIO_OBS_ACCESS_KEY")
+    add_observability_routes(
+        app,
+        registry,
+        access_key=obs_access_key,
+        debug_routes=obs_access_key is not None,
+        readiness={
+            "event_store": _event_store_ready,
+            "metadata_store": _metadata_ready,
+        },
+    )
     m_ingested = registry.counter(
         "pio_events_ingested_total",
         "Events accepted by the event server, by event name",

@@ -16,10 +16,18 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Mapping
 from urllib.parse import parse_qs, unquote, urlsplit
+
+from predictionio_tpu.obs.logging import (
+    REQUEST_ID_HEADER,
+    new_request_id,
+    reset_request_context,
+    set_request_context,
+)
 
 
 @dataclass
@@ -50,16 +58,25 @@ class Response:
     headers: dict[str, str] = field(default_factory=dict)
 
     def encoded(self) -> tuple[bytes, str]:
+        # memoized: the observability layer measures response_bytes and the
+        # front end then encodes for the wire — JSON-serializing a large
+        # prediction body twice per request would be measurable
+        cached = getattr(self, "_encoded_cache", None)
+        if cached is not None:
+            return cached
         if isinstance(self.body, bytes):
-            return self.body, self.content_type or "application/octet-stream"
-        if isinstance(self.body, str):
-            return self.body.encode("utf-8"), self.content_type or (
+            out = self.body, self.content_type or "application/octet-stream"
+        elif isinstance(self.body, str):
+            out = self.body.encode("utf-8"), self.content_type or (
                 "text/html; charset=utf-8"
             )
-        return (
-            json.dumps(self.body).encode("utf-8"),
-            self.content_type or "application/json; charset=utf-8",
-        )
+        else:
+            out = (
+                json.dumps(self.body).encode("utf-8"),
+                self.content_type or "application/json; charset=utf-8",
+            )
+        self._encoded_cache = out
+        return out
 
 
 Handler = Callable[[Request], Response]
@@ -83,6 +100,37 @@ def error_response(status: int, message: str) -> Response:
     return Response(status=status, body={"message": message})
 
 
+def header_get(headers: Mapping[str, str] | None, name: str) -> str:
+    """Case-tolerant header lookup: the threaded server hands out an
+    email.Message (case-insensitive), the aio front end a lower-cased dict,
+    and tests pass plain dicts."""
+    if not headers:
+        return ""
+    return headers.get(name) or headers.get(name.lower()) or ""
+
+
+def presented_key(req: Request) -> str:
+    """The access key a request presents: ``Authorization: Bearer <key>``
+    preferred (doesn't land in proxy/access logs), ``?accessKey=`` kept for
+    dashboard-link parity (Dashboard.scala:47)."""
+    auth = header_get(req.headers, "Authorization")
+    if auth.startswith("Bearer "):
+        return auth[len("Bearer "):]
+    return req.query.get("accessKey", "")
+
+
+def key_matches(req: Request, key: str) -> bool:
+    """Constant-time comparison of the presented key against ``key`` — the
+    ONE credential check every key-gated surface uses (app-level gate and
+    the observability routes), so hardening it lands everywhere at once."""
+    import hmac
+
+    # bytes, not str: compare_digest raises TypeError on non-ASCII str
+    return hmac.compare_digest(
+        presented_key(req).encode("utf-8"), key.encode("utf-8")
+    )
+
+
 class HTTPApp:
     """Route table: (method, compiled path regex) -> handler.
 
@@ -98,37 +146,29 @@ class HTTPApp:
         self.access_key = access_key
         self._routes: list[tuple[str, re.Pattern, Handler]] = []
 
-    def route(self, method: str, pattern: str):
+    def route(self, method: str, pattern: str, public: bool = False):
         """Register a handler; ``pattern`` is a path regex with named groups,
-        anchored at both ends."""
+        anchored at both ends.  ``public=True`` exempts the route from the
+        app-level ``access_key`` gate (liveness probes: load balancers carry
+        no keys)."""
         compiled = re.compile("^" + pattern + "$")
 
         def deco(fn: Handler) -> Handler:
+            if public:
+                fn._pio_public = True  # type: ignore[attr-defined]
             self._routes.append((method.upper(), compiled, fn))
             return fn
 
         return deco
 
     def _key_ok(self, req: Request) -> bool:
-        """Constant-time key check.  Preferred transport is an
-        ``Authorization: Bearer <key>`` header (doesn't land in proxy /
-        access logs); the ``?accessKey=`` query parameter is kept for
-        dashboard-link parity (Dashboard.scala:47)."""
-        import hmac
+        """Constant-time key check (Bearer header or ?accessKey=)."""
+        return key_matches(req, self.access_key)
 
-        auth = req.headers.get("Authorization", "") if req.headers else ""
-        if auth.startswith("Bearer "):
-            presented = auth[len("Bearer "):]
-        else:
-            presented = req.query.get("accessKey", "")
-        # bytes, not str: compare_digest raises TypeError on non-ASCII str
-        return hmac.compare_digest(
-            presented.encode("utf-8"), self.access_key.encode("utf-8")
-        )
-
-    def handle(self, req: Request) -> Response:
-        if self.access_key is not None and not self._key_ok(req):
-            return error_response(401, "Invalid accessKey.")
+    def match(self, req: Request) -> tuple[Handler | None, re.Match | None, int]:
+        """Resolve a request to (handler, match, status): status is 200 when
+        a handler matched, else the 404/405 to answer with.  Shared by both
+        HTTP front ends so routing semantics can't drift."""
         path_matched = False
         for method, pattern, fn in self._routes:
             m = pattern.match(req.path)
@@ -137,14 +177,80 @@ class HTTPApp:
             path_matched = True
             if method != req.method:
                 continue
-            req.params = unquote_groups(m)
-            try:
-                return fn(req)
-            except Exception as e:  # the exceptionHandler analog
-                return error_response(500, f"{type(e).__name__}: {e}")
-        if path_matched:
-            return error_response(405, "Method Not Allowed")
-        return error_response(404, "Not Found")
+            return fn, m, 200
+        return None, None, 405 if path_matched else 404
+
+    def auth_error(self, req: Request, fn: Handler | None) -> Response | None:
+        """App-level key gate for a resolved handler; public routes bypass
+        it.  None means authorized (or no key configured)."""
+        if self.access_key is None:
+            return None
+        if fn is not None and getattr(fn, "_pio_public", False):
+            return None
+        if self._key_ok(req):
+            return None
+        return error_response(401, "Invalid accessKey.")
+
+    def handle(self, req: Request) -> Response:
+        fn, m, status = self.match(req)
+        denied = self.auth_error(req, fn)
+        if denied is not None:
+            return denied
+        if fn is None:
+            return error_response(
+                status,
+                "Method Not Allowed" if status == 405 else "Not Found",
+            )
+        req.params = unquote_groups(m)
+        try:
+            return fn(req)
+        except Exception as e:  # the exceptionHandler analog
+            return error_response(500, f"{type(e).__name__}: {e}")
+
+
+def observe_request(
+    app: HTTPApp, req: Request, call: Callable[[Request], Response]
+) -> Response:
+    """Request-lifecycle bookkeeping shared by the threaded front end (and
+    mirrored in async form by server/aio.py): mint/adopt the request id,
+    bind it to the logging context, wrap the handler in an unrecorded root
+    span, echo ``X-Pio-Request-Id``, and feed the SLO tracker + flight
+    recorder.  Observability/probe paths skip the span + accounting so
+    scrapes never pollute the trace ring or the SLO window."""
+    from predictionio_tpu.obs.flight import begin_annotations, end_annotations
+    from predictionio_tpu.obs.http import (
+        is_observability_path,
+        record_request_outcome,
+    )
+    from predictionio_tpu.obs.tracing import trace
+
+    rid = header_get(req.headers, REQUEST_ID_HEADER) or new_request_id()
+    if is_observability_path(req.path):
+        resp = call(req)
+        resp.headers.setdefault(REQUEST_ID_HEADER, rid)
+        return resp
+    tokens = set_request_context(rid)
+    ann_token = begin_annotations()
+    t0 = time.perf_counter()
+    try:
+        with trace(f"http.{app.name}", record=False) as span:
+            resp = call(req)
+            span.tags = {
+                "method": req.method,
+                "path": req.path,
+                "status": resp.status,
+            }
+        resp.headers.setdefault(REQUEST_ID_HEADER, rid)
+        try:
+            record_request_outcome(
+                app, req, resp, time.perf_counter() - t0, span
+            )
+        except Exception:  # telemetry must never fail the request
+            pass
+        return resp
+    finally:
+        end_annotations(ann_token)
+        reset_request_context(tokens)
 
 
 def _make_handler_class(app: HTTPApp):
@@ -164,7 +270,7 @@ def _make_handler_class(app: HTTPApp):
                 headers=self.headers,
                 body=body,
             )
-            resp = app.handle(req)
+            resp = observe_request(app, req, app.handle)
             payload, ctype = resp.encoded()
             self.send_response(resp.status)
             self.send_header("Content-Type", ctype)

@@ -20,16 +20,20 @@ interpreter exit, long-lived so the hot path never pays thread creation.
 from __future__ import annotations
 
 import asyncio
+import logging
 import threading
 import time
 from collections import deque
 from typing import Any, Callable, Sequence
 
+from predictionio_tpu.obs.logging import get_request_id, ring_debug
 from predictionio_tpu.obs.metrics import (
     REGISTRY,
     MetricsRegistry,
     SIZE_BUCKETS,
 )
+
+log = logging.getLogger("predictionio_tpu.microbatch")
 
 
 class MicroBatcher:
@@ -59,7 +63,10 @@ class MicroBatcher:
         #: how long close() waits for the in-flight wave before abandoning
         #: the daemon worker (was a hard-coded 5.0 s deadline)
         self.drain_timeout_s = drain_timeout_s
-        self._pending: deque[tuple[Any, asyncio.Future, float]] = deque()
+        #: (item, future, enqueue_time, request_id, meta) per pending query
+        self._pending: deque[
+            tuple[Any, asyncio.Future, float, str | None, dict | None]
+        ] = deque()
         self._cond = threading.Condition()
         self._worker: threading.Thread | None = None
         self._in_wave = False
@@ -100,13 +107,24 @@ class MicroBatcher:
         with self._cond:
             return dict(self.wave_sizes)
 
-    async def submit(self, item: Any) -> Any:
+    @property
+    def draining(self) -> bool:
+        """True once close() began — the readiness signal for /readyz."""
+        return self._closed
+
+    async def submit(self, item: Any, meta: dict | None = None) -> Any:
+        """Queue ``item`` for the next wave.  ``meta``, when given, is
+        filled by the worker with this item's queue_wait_s / device_s /
+        wave_size / wave_request_ids before the result future resolves —
+        the per-request latency decomposition for the flight recorder."""
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         with self._cond:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
-            self._pending.append((item, fut, time.perf_counter()))
+            self._pending.append(
+                (item, fut, time.perf_counter(), get_request_id(), meta)
+            )
             self._m_queue_depth.set(len(self._pending))
             if self._worker is None:
                 self._worker = threading.Thread(
@@ -128,7 +146,7 @@ class MicroBatcher:
             self._pending.clear()
             self._cond.notify_all()
         err = RuntimeError("MicroBatcher closed during shutdown")
-        for _, fut, _t in dropped:
+        for _, fut, _t, _rid, _meta in dropped:
             try:
                 fut.get_loop().call_soon_threadsafe(_fail_if_pending, fut, err)
             except RuntimeError:
@@ -160,22 +178,43 @@ class MicroBatcher:
                 self._in_wave = True
                 self._m_queue_depth.set(len(self._pending))
             t_dispatch = time.perf_counter()
-            items = [it for it, _, _ in wave]
-            futures = [f for _, f, _ in wave]
+            items = [it for it, _, _, _, _ in wave]
+            futures = [f for _, f, _, _, _ in wave]
+            rids = [r for _, _, _, r, _ in wave if r]
             self._m_batch_size.observe(len(items))
-            for _, _, t_enq in wave:
+            for _, _, t_enq, _, _ in wave:
                 self._m_queue_wait.observe(t_dispatch - t_enq)
+            # the correlation line: a wave's log entry names the requests it
+            # coalesced, so one slow query's request_id finds its wave
+            # mates.  ring_debug reaches /logs.json even when the embedding
+            # app never configured logging.
+            ring_debug(
+                log,
+                "microbatch wave dispatched",
+                wave_size=len(items),
+                request_ids=rids,
+            )
             # all futures in a wave come from submit() calls on the same
             # server loop; resolve with ONE loop wakeup
             loop = futures[0].get_loop()
             try:
                 results = self.batch_fn(items)
-                self._m_device_time.observe(time.perf_counter() - t_dispatch)
+                device_s = time.perf_counter() - t_dispatch
+                self._m_device_time.observe(device_s)
                 if len(results) != len(items):
                     raise RuntimeError(
                         f"batch_fn returned {len(results)} results "
                         f"for {len(items)} items"
                     )
+                # fill per-item timing meta BEFORE resolving the futures:
+                # call_soon_threadsafe orders these writes before the
+                # submitter's read on the loop thread
+                for _, _, t_enq, _, meta in wave:
+                    if meta is not None:
+                        meta["queue_wait_s"] = round(t_dispatch - t_enq, 6)
+                        meta["device_s"] = round(device_s, 6)
+                        meta["wave_size"] = len(items)
+                        meta["wave_request_ids"] = rids
                 # under the cond: the status page reads wave_sizes from
                 # other threads, and dict writes must not race its snapshot
                 with self._cond:

@@ -37,8 +37,10 @@ from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage.base import EngineInstance
 from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
-from predictionio_tpu.obs.http import add_metrics_routes
+from predictionio_tpu.obs.flight import annotate
+from predictionio_tpu.obs.http import add_observability_routes
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from predictionio_tpu.obs.tracing import trace
 from predictionio_tpu.server.httpd import (
     AppServer,
     HTTPApp,
@@ -208,7 +210,32 @@ def create_prediction_server_app(
     stats_lock = threading.Lock()
     started_at = datetime.now(tz=timezone.utc)
     registry = registry or REGISTRY
-    add_metrics_routes(app, registry)
+
+    # /readyz: a load balancer should only route here when the model is
+    # bound, the MicroBatcher accepts work, and the event store answers
+    def _model_loaded() -> bool:
+        return getattr(deployed, "models", None) is not None
+
+    def _batcher_ready() -> bool:
+        batcher = getattr(app, "microbatcher", None)
+        return batcher is None or not batcher.draining
+
+    def _event_store_ready() -> bool:
+        storage = getattr(deployed, "storage", None)
+        if storage is None:  # no store configured (embedded test engines)
+            return True
+        return storage.l_events() is not None
+
+    add_observability_routes(
+        app,
+        registry,
+        access_key=access_key,
+        readiness={
+            "model_loaded": _model_loaded,
+            "microbatcher": _batcher_ready,
+            "event_store": _event_store_ready,
+        },
+    )
     m_latency = registry.histogram(
         "pio_request_latency_seconds",
         "Serving request latency by route and status",
@@ -402,12 +429,19 @@ def create_prediction_server_app(
             except Exception as e:
                 _observe("/queries.json", 400, t0)
                 return error_response(400, f"invalid query: {e}")
+            # the worker fills meta with this query's queue-wait/device
+            # split + wave mates; annotate() hands it to the flight recorder
+            meta: dict[str, Any] = {}
             try:
-                status, value = await batcher.submit(payload)
+                with trace("serve.microbatch", record=False):
+                    status, value = await batcher.submit(payload, meta)
             except Exception as e:
                 log.exception("query serving failed")
                 _observe("/queries.json", 500, t0)
                 return error_response(500, f"{type(e).__name__}: {e}")
+            finally:
+                if meta:
+                    annotate(**meta)
             if status == "bad":
                 _observe("/queries.json", 400, t0)
                 return error_response(400, f"invalid query: {value}")
@@ -474,31 +508,9 @@ def create_prediction_server_app(
             threading.Thread(target=on_stop, daemon=True).start()
         return json_response(200, {"message": "Shutting down."})
 
-    # -- profiling (the jax.profiler analog of Spark's job UI, SURVEY §5.1) --
-    @app.route("POST", "/profiler/start")
-    def profiler_start(req: Request) -> Response:
-        if not _authorized(req):
-            return error_response(401, "Invalid accessKey.")
-        import jax
-
-        trace_dir = req.query.get("dir", "/tmp/pio-profile")
-        try:
-            jax.profiler.start_trace(trace_dir)
-        except Exception as e:
-            return error_response(409, f"profiler not started: {e}")
-        return json_response(200, {"message": "tracing", "dir": trace_dir})
-
-    @app.route("POST", "/profiler/stop")
-    def profiler_stop(req: Request) -> Response:
-        if not _authorized(req):
-            return error_response(401, "Invalid accessKey.")
-        import jax
-
-        try:
-            jax.profiler.stop_trace()
-        except Exception as e:
-            return error_response(409, f"profiler not stopped: {e}")
-        return json_response(200, {"message": "trace written"})
+    # profiling now lives at POST /debug/profile (obs/http.py): bounded
+    # capture window, off-request-thread stop, key-required arming — the
+    # old ungated /profiler/start|stop pair is gone
 
     return app
 

@@ -73,8 +73,16 @@ def _chan(req: Request) -> int | None:
 def create_storage_app(
     runtime: StorageRuntime, access_key: str | None = None
 ) -> HTTPApp:
+    from predictionio_tpu.obs.http import add_observability_routes
+
     app = HTTPApp("storage-server", access_key=access_key)
     rt = runtime
+
+    def _metadata_ready() -> bool:
+        rt.access_keys().get("__readyz_probe__")
+        return True
+
+    add_observability_routes(app, readiness={"metadata_store": _metadata_ready})
 
     @app.route("GET", r"/v1/ping")
     def ping(req: Request) -> Response:

@@ -69,7 +69,11 @@ def do_version(args) -> int:
 
 
 def do_status(args) -> int:
-    """`pio status` (commands/Management.scala): storage connectivity probe."""
+    """`pio status` (commands/Management.scala): storage connectivity probe,
+    or — with ``--url`` — the health surface of a running daemon
+    (/healthz + /readyz + /slo.json)."""
+    if getattr(args, "url", None):
+        return _status_remote(args.url, getattr(args, "access_key", None))
     storage = get_storage()
     import jax
 
@@ -83,6 +87,43 @@ def do_status(args) -> int:
         }
     )
     return 0 if all(checks.values()) else 1
+
+
+def _status_remote(url: str, access_key: str | None = None) -> int:
+    """Read a running server's health endpoints.  Exit 0 only when the
+    daemon is alive AND ready; readiness 503s still print their body so the
+    operator sees WHICH check fails.  ``access_key`` rides as a Bearer
+    header — key-gated servers 401 /readyz and /slo.json without it
+    (/healthz alone is always open)."""
+    import urllib.error
+    import urllib.request
+
+    base = url.rstrip("/")
+    headers = (
+        {"Authorization": f"Bearer {access_key}"} if access_key else {}
+    )
+
+    def fetch(path: str) -> tuple[int, Any]:
+        try:
+            req = urllib.request.Request(base + path, headers=headers)
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, json.loads(r.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read().decode("utf-8"))
+            except Exception:
+                return e.code, {"message": str(e)}
+        except Exception as e:  # daemon down / refused / timeout — the
+            return 0, {"message": f"unreachable: {e}"}  # primary use case
+
+    health_status, health = fetch("/healthz")
+    ready_status, ready = fetch("/readyz")
+    _slo_status, slo = fetch("/slo.json")
+    _print(
+        {"url": base, "healthz": health, "readyz": ready, "slo": slo}
+    )
+    alive = health_status == 200 and health.get("status") == "alive"
+    return 0 if alive and ready_status == 200 else 1
 
 
 def do_app(args) -> int:
@@ -582,23 +623,68 @@ def do_metrics(args) -> int:
     With ``--url``, scrapes a running server's exposition endpoint
     (``/metrics`` or ``/metrics.json``); without it, dumps this process's
     registry — useful at the end of in-process runs (`pio train` emits the
-    DASE stage histograms, `pio eval` the fold spans).
+    DASE stage histograms, `pio eval` the fold spans).  ``--watch SECONDS``
+    re-renders periodically (Ctrl-C to stop).
     """
-    from predictionio_tpu.obs.metrics import REGISTRY
+    import threading
 
-    if args.url:
-        import urllib.request
+    def render_once() -> None:
+        from predictionio_tpu.obs.metrics import REGISTRY
 
-        path = "/metrics.json" if args.json else "/metrics"
-        url = args.url.rstrip("/") + path
-        with urllib.request.urlopen(url, timeout=10) as r:
-            body = r.read().decode("utf-8")
-        print(body if not args.json else json.dumps(json.loads(body), indent=2))
+        if args.url:
+            import urllib.request
+
+            path = "/metrics.json" if args.json else "/metrics"
+            url = args.url.rstrip("/") + path
+            headers = (
+                {"Authorization": f"Bearer {args.access_key}"}
+                if getattr(args, "access_key", None)
+                else {}
+            )
+            req = urllib.request.Request(url, headers=headers)
+            with urllib.request.urlopen(req, timeout=10) as r:
+                body = r.read().decode("utf-8")
+            print(
+                body
+                if not args.json
+                else json.dumps(json.loads(body), indent=2)
+            )
+        elif args.json:
+            _print(REGISTRY.render_json())
+        else:
+            print(REGISTRY.render_prometheus(), end="")
+
+    if not args.watch:
+        try:
+            render_once()
+        except Exception as e:  # dead daemon: message + exit 1, no traceback
+            print(f"scrape failed: {e}", file=sys.stderr)
+            return 1
         return 0
-    if args.json:
-        _print(REGISTRY.render_json())
-    else:
-        print(REGISTRY.render_prometheus(), end="")
+    if args.watch < 0:
+        print("usage error: --watch must be positive", file=sys.stderr)
+        return 2
+    import datetime as _dt
+
+    # Event.wait as the timer (not a sleep poll): interruptible, and the
+    # loop body is the work — there is nothing to busy-wait on
+    pacer = threading.Event()
+    remaining = args.watch_count  # None = forever (operator Ctrl-C)
+    try:
+        while remaining is None or remaining > 0:
+            print(f"--- pio metrics @ {_dt.datetime.now().isoformat()} ---")
+            try:
+                render_once()
+            except Exception as e:  # a watch must survive server restarts
+                print(f"scrape failed: {e}", file=sys.stderr)
+            sys.stdout.flush()
+            if remaining is not None:
+                remaining -= 1
+                if remaining == 0:
+                    break
+            pacer.wait(args.watch)
+    except KeyboardInterrupt:
+        pass
     return 0
 
 
@@ -716,7 +802,20 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="command", required=True)
 
     sub.add_parser("version").set_defaults(fn=do_version)
-    sub.add_parser("status").set_defaults(fn=do_status)
+    stt = sub.add_parser("status")
+    stt.add_argument(
+        "--url",
+        default=None,
+        help="probe a running server's /healthz, /readyz, and /slo.json "
+        "(e.g. http://127.0.0.1:8000) instead of local storage",
+    )
+    stt.add_argument(
+        "--access-key",
+        default=None,
+        help="access key for key-gated servers (sent as a Bearer header; "
+        "/healthz alone answers without it)",
+    )
+    stt.set_defaults(fn=do_status)
 
     ap = sub.add_parser("app")
     asub = ap.add_subparsers(dest="app_command", required=True)
@@ -899,6 +998,24 @@ def build_parser() -> argparse.ArgumentParser:
         "--json", action="store_true", help="JSON exposition instead of "
         "Prometheus text"
     )
+    mt.add_argument(
+        "--access-key",
+        default=None,
+        help="access key for key-gated servers (sent as a Bearer header)",
+    )
+    mt.add_argument(
+        "--watch",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="re-render every SECONDS until interrupted",
+    )
+    mt.add_argument(
+        "--watch-count",
+        type=int,
+        default=None,
+        help=argparse.SUPPRESS,  # bounded --watch iterations (tests)
+    )
     mt.set_defaults(fn=do_metrics)
 
     ck = sub.add_parser(
@@ -954,16 +1071,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     # the console is the reference's log4j-INFO surface: workflow progress
-    # (incl. the DASE stage breakdown) must reach the operator's terminal
-    import logging
+    # (incl. the DASE stage breakdown) must reach the operator's terminal.
+    # configure_logging emits collector-parseable JSON lines (request-id
+    # correlated) by default; PIO_LOG_FORMAT=text for humans, PIO_LOG_LEVEL
+    # for verbosity — a typo'd env var must not crash every verb.
+    from predictionio_tpu.obs.logging import configure_logging
 
-    level = os.environ.get("PIO_LOG_LEVEL", "INFO").upper()
-    if not isinstance(getattr(logging, level, None), int):
-        level = "INFO"  # a typo'd env var must not crash every verb
-    logging.basicConfig(
-        level=level,
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
-    )
+    configure_logging()
     args = build_parser().parse_args(argv)
     try:
         return args.fn(args)

@@ -79,6 +79,48 @@ def test_busy_wait_fix_stays_fixed():
     assert report.pragma_suppressed == 0
 
 
+def test_obs_modules_lint_clean():
+    """The request-lifecycle observability modules (logging, flight, slo,
+    profiler, http, tracing, metrics) must be clean under `pio check` with
+    NO new baselined findings and no pragma suppressions — telemetry code
+    runs on every request and gets no lint exemptions."""
+    report = analyze_paths([PACKAGE / "obs"], root=REPO_ROOT)
+    assert report.errors == []
+    assert report.findings == [], "\n".join(f.text() for f in report.findings)
+    assert report.pragma_suppressed == 0
+
+
+def test_profiler_capture_runs_off_request_thread():
+    """PIO-CONC-aware gate for /debug/profile: the profiler module must be
+    free of concurrency findings (no busy-waits, no blocking calls hidden in
+    async defs), and the capture wait must structurally live on a dedicated
+    background thread — the HTTP handler only arms the trace.  A profiler
+    that sleeps N seconds on a request thread would pin an executor slot for
+    the whole capture."""
+    import ast
+
+    report = analyze_paths([PACKAGE / "obs" / "profiler.py"], root=REPO_ROOT)
+    conc = [f for f in report.findings if f.rule.startswith("PIO-CONC")]
+    assert conc == [], "\n".join(f.text() for f in conc)
+    # structural: start() hands the wait to a thread and never waits itself,
+    # _finish (the waiter) runs nowhere but on that thread.  Asserted on the
+    # AST of ProfilerController so unrelated edits can't false-positive.
+    tree = ast.parse((PACKAGE / "obs" / "profiler.py").read_text())
+    cls = next(
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, ast.ClassDef) and n.name == "ProfilerController"
+    )
+    methods = {
+        n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)
+    }
+    start_src = ast.unparse(methods["start"])
+    assert "threading.Thread" in start_src and "daemon=True" in start_src
+    assert "_finish" in start_src  # the thread target is the waiter
+    assert ".wait(" not in start_src  # start() itself never blocks
+    assert ".wait(" in ast.unparse(methods["_finish"])  # the thread does
+
+
 def test_bundled_engine_contracts_gate():
     """DASE pre-flight part of the gate: every bundled engine factory
     passes the contract check."""
