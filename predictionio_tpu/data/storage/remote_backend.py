@@ -41,6 +41,7 @@ from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import EventFilter, EventFrame
 from predictionio_tpu.data.storage.frame_codec import decode_frame, encode_frame
+from predictionio_tpu.obs.logging import REQUEST_ID_HEADER, get_request_id
 
 
 class RemoteStorageError(Exception):
@@ -222,6 +223,13 @@ class RemoteClient:
         if q:
             path = f"{path}?{urlencode(q)}"
         headers = {"Content-Type": content_type} if body is not None else {}
+        rid = get_request_id()
+        if rid:
+            # cross-daemon correlation: forward the originating request's id
+            # so the daemon's /logs.json and flight entries carry it — the
+            # daemon's front end adopts any incoming X-Pio-Request-Id, so
+            # without this the id dies at the process boundary
+            headers[REQUEST_ID_HEADER] = rid
         if self.auth_key is not None:
             # header, not query param: keys in URLs land in proxy/access
             # logs; the daemon accepts both but prefers Authorization

@@ -5,10 +5,11 @@ histograms (metrics.py), context-manager spans with a recent-trace ring
 (tracing.py), request-id-correlated JSON-lines logging with an in-process
 ring (logging.py), a flight recorder for the slowest/errored requests
 (flight.py), rolling-window SLO tracking with burn rates + health routes
-(slo.py), on-demand jax.profiler capture (profiler.py), HTTP exposition for
-all of it (http.py), and a sniffer plugin proving the plugin seams can
-consume the registry (plugin.py).  Dependency-free; the process-global
-default registry is ``REGISTRY``.
+(slo.py), on-demand jax.profiler capture (profiler.py), online model-quality
+monitoring — prediction log, feedback joins, drift detection (quality.py) —
+HTTP exposition for all of it (http.py), and a sniffer plugin proving the
+plugin seams can consume the registry (plugin.py).  Dependency-free; the
+process-global default registry is ``REGISTRY``.
 """
 
 from predictionio_tpu.obs.flight import FLIGHT, FlightRecorder, annotate
@@ -32,11 +33,18 @@ from predictionio_tpu.obs.metrics import (
     Counter,
     Gauge,
     Histogram,
+    MetricsHistory,
     MetricsRegistry,
     default_registry,
     quantile_from_buckets,
 )
 from predictionio_tpu.obs.profiler import PROFILER, sample_runtime_gauges
+from predictionio_tpu.obs.quality import (
+    DriftDetector,
+    HistogramSketch,
+    QualityMonitor,
+    default_quality,
+)
 from predictionio_tpu.obs.slo import SLOTracker
 from predictionio_tpu.obs.tracing import (
     Span,
@@ -62,14 +70,19 @@ __all__ = [
     "STAGE_BUCKETS",
     "TRAIN_BUCKETS",
     "Counter",
+    "DriftDetector",
     "Gauge",
     "Histogram",
+    "HistogramSketch",
+    "MetricsHistory",
     "MetricsRegistry",
+    "QualityMonitor",
     "Span",
     "annotate",
     "clear_traces",
     "configure_logging",
     "current_span",
+    "default_quality",
     "default_registry",
     "get_log_ring",
     "get_request_id",

@@ -12,6 +12,9 @@ onto a server:
   GET  /debug/flight.json   flight recorder: N slowest + errored requests
   POST /debug/profile       start a jax.profiler capture (?seconds=N&dir=)
   GET  /debug/profile       capture status (running / last)
+  GET  /quality.json        online model quality: per-variant metrics +
+                            drift state (servers constructed with a
+                            QualityMonitor)
   GET  /healthz             liveness — ALWAYS ungated (load balancers carry
                             no keys); advisory SLO status rides along
   GET  /readyz              readiness checks (model loaded, stores up, ...)
@@ -56,6 +59,7 @@ _OBS_PATHS = frozenset(
         "/metrics.json",
         "/traces.json",
         "/logs.json",
+        "/quality.json",
         "/healthz",
         "/readyz",
         "/slo.json",
@@ -117,6 +121,7 @@ def add_observability_routes(
     slo: SLOTracker | None = None,
     flight: FlightRecorder | None = None,
     debug_routes: bool = True,
+    quality: Any | None = None,
 ):
     """The full observability surface: metrics + logs + flight + profiler +
     health.  Installs ``app.slo`` / ``app.flight`` / ``app.readiness`` so
@@ -126,10 +131,15 @@ def add_observability_routes(
     whose ``HTTPApp(access_key=...)`` already gates globally, ``/healthz``
     is registered public so load balancers can always probe liveness.
 
-    ``debug_routes=False`` skips /logs.json, /debug/flight.json, and
-    /debug/profile entirely: servers that must stay open to anonymous
-    clients (the event server's ingest port) expose the scrape surface but
-    not log contents, error bodies, or an anonymous profiler trigger.
+    ``debug_routes=False`` skips /logs.json, /debug/flight.json,
+    /debug/profile, and /quality.json entirely: servers that must stay open
+    to anonymous clients (the event server's ingest port) expose the scrape
+    surface but not log contents, error bodies, or an anonymous profiler
+    trigger.
+
+    ``quality`` (a :class:`~predictionio_tpu.obs.quality.QualityMonitor`)
+    installs ``app.quality`` and — on debug-route servers — serves its
+    snapshot at ``GET /quality.json``, gated like the other debug routes.
     """
     from predictionio_tpu.server.httpd import (
         Request,
@@ -145,6 +155,8 @@ def add_observability_routes(
     # must not pay per-request entry construction for records nothing serves
     app.flight = (flight or FlightRecorder()) if debug_routes else None
     app.readiness = dict(readiness or {})
+    if quality is not None:
+        app.quality = quality
     ring = get_log_ring()
 
     original_route = app.route
@@ -171,9 +183,20 @@ def add_observability_routes(
         route = original_route
 
     # -- metrics + traces (gated when a key is configured) -------------------
+    def _prescrape() -> None:
+        """Freshen scrape-time state: JAX runtime gauges, online-quality
+        gauges (rate-limited — a feedback outage must show up as decaying
+        values, not frozen ones), THEN the sparkline ring so it samples the
+        refreshed numbers."""
+        sample_runtime_gauges(reg)
+        q = getattr(app, "quality", None)
+        if q is not None:
+            q.refresh_gauges()
+        reg.history.sample(reg)
+
     @route("GET", "/metrics")
     def metrics(req: Request) -> Response:
-        sample_runtime_gauges(reg)
+        _prescrape()
         return Response(
             200,
             reg.render_prometheus(),
@@ -182,7 +205,7 @@ def add_observability_routes(
 
     @route("GET", "/metrics\\.json")
     def metrics_json(req: Request) -> Response:
-        sample_runtime_gauges(reg)
+        _prescrape()
         return json_response(200, reg.render_json())
 
     @route("GET", "/traces\\.json")
@@ -216,6 +239,13 @@ def add_observability_routes(
             json.dumps({"logs": records}, default=str),
             content_type="application/json; charset=utf-8",
         )
+
+    # -- online model quality ------------------------------------------------
+    if quality is not None:
+
+        @route("GET", "/quality\\.json")
+        def quality_json(req: Request) -> Response:
+            return json_response(200, app.quality.snapshot())
 
     # -- flight recorder -----------------------------------------------------
     @route("GET", "/debug/flight\\.json")

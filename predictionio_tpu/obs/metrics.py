@@ -24,6 +24,7 @@ import json
 import math
 import threading
 from bisect import bisect_left
+from collections import deque
 from typing import Any, Iterable, Mapping
 
 #: Fixed log-spaced bucket upper bounds in seconds: 10 µs .. 10 s, four per
@@ -233,17 +234,72 @@ class MetricFamily:
             return sorted(self._children.items())
 
 
+class MetricsHistory:
+    """Bounded per-series history ring, sampled on scrape.
+
+    One fixed-depth deque per (family name, label values): counters and
+    gauges record their value, histograms their p95 — enough for the
+    dashboard sparklines (model quality, serving latency) without a
+    time-series backend.  ``sample`` is called by the ``/metrics``(.json)
+    scrape handlers and by the dashboard render, so the ring advances at
+    scrape cadence and memory stays ``depth × series-cardinality`` (series
+    cardinality is already bounded upstream by the label guards).
+    """
+
+    def __init__(self, depth: int = 60):
+        self.depth = max(depth, 2)
+        self._lock = threading.Lock()
+        self._series: dict[tuple[str, tuple[str, ...]], deque[float]] = {}
+
+    def sample(self, registry: "MetricsRegistry") -> None:
+        for fam in registry.families():
+            for lv, child in fam.series():
+                if fam.kind == "histogram":
+                    counts, _, count = child.snapshot()
+                    value = quantile_from_buckets(
+                        fam.buckets, counts, count, 0.95
+                    )
+                else:
+                    value = child.value
+                key = (fam.name, lv)
+                with self._lock:
+                    dq = self._series.get(key)
+                    if dq is None:
+                        dq = self._series[key] = deque(maxlen=self.depth)
+                    dq.append(float(value))
+
+    def series(
+        self, name: str, labels: tuple[str, ...] = ()
+    ) -> list[float]:
+        """Sampled values for one series, oldest first."""
+        with self._lock:
+            dq = self._series.get((name, tuple(labels)))
+            return list(dq) if dq else []
+
+    def items(self, name: str) -> list[tuple[tuple[str, ...], list[float]]]:
+        """Every sampled series of one family: (label values, history)."""
+        with self._lock:
+            return sorted(
+                (lv, list(dq))
+                for (n, lv), dq in self._series.items()
+                if n == name
+            )
+
+
 class MetricsRegistry:
     """Thread-safe name → :class:`MetricFamily` registry.
 
     Re-declaring a family with the same (kind, labelnames) returns the
     existing one, so instrumentation points can declare their metrics at
     call-site construction time without coordinating module import order.
+    Each registry owns a :class:`MetricsHistory` (``.history``) fed on every
+    scrape — the sparkline backing store.
     """
 
     def __init__(self):
         self._lock = threading.Lock()
         self._families: dict[str, MetricFamily] = {}
+        self.history = MetricsHistory()
 
     def _family(
         self,

@@ -15,6 +15,7 @@ from urllib.parse import quote
 from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
 from predictionio_tpu.obs.http import add_observability_routes
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from predictionio_tpu.obs.quality import QualityMonitor, default_quality
 from predictionio_tpu.obs.slo import run_readiness
 from predictionio_tpu.obs.tracing import recent_traces
 from predictionio_tpu.server.httpd import (
@@ -26,12 +27,31 @@ from predictionio_tpu.server.httpd import (
 )
 
 
+#: eight-level unicode sparkline alphabet (min → max of the series)
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: list[float]) -> str:
+    """Render a sampled series as a fixed-height unicode sparkline."""
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    top = len(_SPARK_CHARS) - 1
+    return "".join(
+        _SPARK_CHARS[int((v - lo) / span * top)] for v in values
+    )
+
+
 def _metrics_table_html(registry: MetricsRegistry) -> str:
     """The registry as an HTML table: counters/gauges with their value,
-    histograms with count + p50/p95/p99 (computed from the log buckets)."""
+    histograms with count + p50/p95/p99 (computed from the log buckets),
+    plus a per-series sparkline from the scrape-fed history ring — which is
+    what gives the serving-latency rows their trend at a glance."""
     rows = []
     for name, fam in sorted(registry.render_json().items()):
         for s in fam["series"]:
+            label_values = tuple(str(v) for v in s["labels"].values())
             labels = ",".join(f"{k}={v}" for k, v in s["labels"].items())
             if fam["type"] in ("counter", "gauge"):
                 detail = f"{s['value']:g}"
@@ -40,16 +60,71 @@ def _metrics_table_html(registry: MetricsRegistry) -> str:
                     f"n={s['count']} p50={s['p50']:.6f} "
                     f"p95={s['p95']:.6f} p99={s['p99']:.6f}"
                 )
+            spark = _sparkline(registry.history.series(name, label_values))
             rows.append(
                 f"<tr><td>{html.escape(name)}</td>"
                 f"<td>{html.escape(labels)}</td>"
                 f"<td>{html.escape(fam['type'])}</td>"
-                f"<td>{html.escape(detail)}</td></tr>"
+                f"<td>{html.escape(detail)}</td>"
+                f"<td>{html.escape(spark)}</td></tr>"
             )
     return (
         "<h2>Metrics</h2><table border='1'>"
-        "<tr><th>metric</th><th>labels</th><th>type</th><th>value</th></tr>"
+        "<tr><th>metric</th><th>labels</th><th>type</th><th>value</th>"
+        "<th>trend</th></tr>"
         + "".join(rows)
+        + "</table>"
+    )
+
+
+def _quality_html(quality: QualityMonitor, registry: MetricsRegistry) -> str:
+    """Model-quality panel: drift state per distribution and the rolling
+    online metrics per engine variant, with sparklines from the history
+    ring (``pio_online_metric{variant,metric}``).
+
+    Side effect: the render IS a scrape — ``snapshot()`` refreshes the
+    quality gauges and the history ring then samples the registry, in that
+    order, so every trend tail on the page (this panel and the metrics
+    table below it) matches the value column instead of lagging a render.
+    """
+    snap = quality.snapshot()
+    registry.history.sample(registry)
+    drift = snap["drift"]
+    drift_rows = "".join(
+        f"<tr><td>{html.escape(name)}</td>"
+        f"<td>{html.escape(d['state'])}</td>"
+        f"<td>{d['psi']:.4f}</td><td>{d['ks']:.4f}</td>"
+        f"<td>{d['windows']}</td><td>{d['transitions']}</td>"
+        f"<td>{html.escape(_sparkline(registry.history.series('pio_drift_psi', (name,))))}</td></tr>"
+        for name, d in drift["distributions"].items()
+    )
+    variant_rows = []
+    for variant, v in snap["variants"].items():
+        for metric, value in v["metrics"].items():
+            spark = _sparkline(
+                registry.history.series("pio_online_metric", (variant, metric))
+            )
+            variant_rows.append(
+                f"<tr><td>{html.escape(variant)}</td>"
+                f"<td>{html.escape(metric)}</td>"
+                f"<td>{'n/a' if value is None else f'{value:.4f}'}</td>"
+                f"<td>{html.escape(spark)}</td></tr>"
+            )
+        variant_rows.append(
+            f"<tr><td>{html.escape(variant)}</td><td>volume</td>"
+            f"<td>{v['predictions']} predictions, {v['joined']} joined</td>"
+            f"<td></td></tr>"
+        )
+    return (
+        f"<h2>Model quality</h2><p>drift: <b>{html.escape(drift['state'])}</b>"
+        f", prediction log {snap['log']['size']}/{snap['log']['capacity']}</p>"
+        "<table border='1'><tr><th>distribution</th><th>state</th>"
+        "<th>psi</th><th>ks</th><th>windows</th><th>transitions</th>"
+        "<th>trend</th></tr>"
+        + drift_rows
+        + "</table><table border='1'><tr><th>variant</th><th>metric</th>"
+        "<th>value</th><th>trend</th></tr>"
+        + "".join(variant_rows)
         + "</table>"
     )
 
@@ -117,22 +192,32 @@ def _health_html(app: HTTPApp) -> str:
 
 
 def create_dashboard_app(
-    storage: StorageRuntime | None = None, access_key: str | None = None
+    storage: StorageRuntime | None = None,
+    access_key: str | None = None,
+    quality: QualityMonitor | None = None,
 ) -> HTTPApp:
     """``access_key`` gates every route (Dashboard.scala:47 mixes in
     KeyAuthentication); TLS comes from the AppServer layer below."""
     storage = storage or get_storage()
     app = HTTPApp("dashboard", access_key=access_key)
+    quality = quality or default_quality()
 
     def _metadata_ready() -> bool:
         storage.evaluation_instances().get_completed()
         return True
 
     # app-level access_key (when set) gates these; /healthz stays public
-    add_observability_routes(app, readiness={"metadata_store": _metadata_ready})
+    add_observability_routes(
+        app, readiness={"metadata_store": _metadata_ready}, quality=quality
+    )
 
     @app.route("GET", "/")
     def index(req: Request) -> Response:
+        # rendered before the page body: _quality_html refreshes the
+        # quality gauges and advances the sparkline ring (see its
+        # docstring), so the panels self-populate with CURRENT values even
+        # with no external Prometheus scraper
+        quality_html = _quality_html(quality, REGISTRY)
         instances = storage.evaluation_instances().get_completed()
         rows = "".join(
             f"<tr><td><a href='/engine_instances/{html.escape(i.id)}'>"
@@ -150,6 +235,7 @@ def create_dashboard_app(
             "<table border='1'><tr><th>id</th><th>evaluation</th>"
             f"<th>started</th><th>finished</th><th>result</th></tr>{rows}"
             f"</table>{_health_html(app)}"
+            f"{quality_html}"
             f"{_traces_table_html(access_key=access_key)}"
             f"{_metrics_table_html(REGISTRY)}</body></html>",
         )

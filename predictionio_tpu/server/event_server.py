@@ -37,7 +37,9 @@ from predictionio_tpu.data.webhooks import (
 )
 from predictionio_tpu.data.datamap import parse_event_time
 from predictionio_tpu.obs.http import add_observability_routes
+from predictionio_tpu.obs.logging import get_request_id
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from predictionio_tpu.obs.quality import QualityMonitor, default_quality
 from predictionio_tpu.server.httpd import (
     AppServer,
     HTTPApp,
@@ -97,6 +99,7 @@ def create_event_server_app(
     plugins: "PluginContext | None" = None,
     registry: MetricsRegistry | None = None,
     obs_access_key: str | None = None,
+    quality: QualityMonitor | None = None,
 ) -> HTTPApp:
     import os
 
@@ -108,6 +111,16 @@ def create_event_server_app(
     levents = storage.l_events()
     plugins = plugins or PluginContext.from_env()
     registry = registry or REGISTRY
+    # the feedback-joiner half of online model quality: ingested feedback
+    # events join back to the prediction log this monitor holds.  Default
+    # to the process-global monitor so a single-VM deployment (prediction +
+    # event server in one process) closes the loop with zero configuration.
+    if quality is None:
+        quality = (
+            default_quality()
+            if registry is REGISTRY
+            else QualityMonitor(registry=registry)
+        )
 
     def _event_store_ready() -> bool:
         # live probe, not a captured handle: run_readiness treats a raise
@@ -136,6 +149,7 @@ def create_event_server_app(
             "event_store": _event_store_ready,
             "metadata_store": _metadata_ready,
         },
+        quality=quality,
     )
     m_ingested = registry.counter(
         "pio_events_ingested_total",
@@ -167,6 +181,12 @@ def create_event_server_app(
             else:
                 seen_event_labels.add(name)
         m_ingested.labels(name).inc()
+        if quality.is_feedback(event.event):
+            # the join key preference order: the X-Pio-Request-Id the client
+            # echoed on this ingest call (bound to the request context by
+            # the front end), then the event's own prId / pioRequestId,
+            # then entity id within the join window (observe_feedback)
+            quality.observe_feedback(event, request_id=get_request_id())
         if hourly is not None:
             hourly.update(
                 auth.app_id,

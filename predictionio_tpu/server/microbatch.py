@@ -73,6 +73,10 @@ class MicroBatcher:
         self._closed = False
         #: wave-size histogram for the status page ({batch_size: count})
         self.wave_sizes: dict[int, int] = {}
+        #: monotonically increasing wave number, exposed through per-item
+        #: meta so downstream consumers (flight recorder, prediction log)
+        #: can tell which dispatch wave served a request
+        self._wave_seq = 0
         reg = registry or REGISTRY
         self._m_queue_depth = reg.gauge(
             "pio_microbatch_queue_depth",
@@ -176,6 +180,8 @@ class MicroBatcher:
                     for _ in range(min(len(self._pending), self.max_batch))
                 ]
                 self._in_wave = True
+                self._wave_seq += 1
+                wave_seq = self._wave_seq
                 self._m_queue_depth.set(len(self._pending))
             t_dispatch = time.perf_counter()
             items = [it for it, _, _, _, _ in wave]
@@ -192,6 +198,7 @@ class MicroBatcher:
                 log,
                 "microbatch wave dispatched",
                 wave_size=len(items),
+                wave_seq=wave_seq,
                 request_ids=rids,
             )
             # all futures in a wave come from submit() calls on the same
@@ -214,6 +221,7 @@ class MicroBatcher:
                         meta["queue_wait_s"] = round(t_dispatch - t_enq, 6)
                         meta["device_s"] = round(device_s, 6)
                         meta["wave_size"] = len(items)
+                        meta["wave_seq"] = wave_seq
                         meta["wave_request_ids"] = rids
                 # under the cond: the status page reads wave_sizes from
                 # other threads, and dict writes must not race its snapshot

@@ -39,7 +39,9 @@ from predictionio_tpu.data.storage.base import EngineInstance
 from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
 from predictionio_tpu.obs.flight import annotate
 from predictionio_tpu.obs.http import add_observability_routes
+from predictionio_tpu.obs.logging import get_request_id
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from predictionio_tpu.obs.quality import QualityMonitor, default_quality
 from predictionio_tpu.obs.tracing import trace
 from predictionio_tpu.server.httpd import (
     AppServer,
@@ -200,6 +202,7 @@ def create_prediction_server_app(
     max_batch: int = 32,
     drain_timeout_s: float = 5.0,
     registry: MetricsRegistry | None = None,
+    quality: QualityMonitor | None = None,
 ) -> HTTPApp:
     from predictionio_tpu.server.plugins import PluginContext
 
@@ -210,6 +213,18 @@ def create_prediction_server_app(
     stats_lock = threading.Lock()
     started_at = datetime.now(tz=timezone.utc)
     registry = registry or REGISTRY
+    # the process-default monitor on the default registry (so the event
+    # server's feedback joiner sees the same prediction log in a single-VM
+    # deployment); an explicit registry gets its own isolated monitor
+    if quality is None:
+        quality = (
+            default_quality()
+            if registry is REGISTRY
+            else QualityMonitor(registry=registry)
+        )
+    variant_label = (
+        getattr(deployed.instance, "engine_variant", None) or "default"
+    )
 
     # /readyz: a load balancer should only route here when the model is
     # bound, the MicroBatcher accepts work, and the event store answers
@@ -235,6 +250,7 @@ def create_prediction_server_app(
             "microbatcher": _batcher_ready,
             "event_store": _event_store_ready,
         },
+        quality=quality,
     )
     m_latency = registry.histogram(
         "pio_request_latency_seconds",
@@ -334,6 +350,9 @@ def create_prediction_server_app(
             stats["avg_serving_sec"] = (stats["avg_serving_sec"] * n + dt) / (n + 1)
             stats["last_serving_sec"] = dt
             stats["request_count"] = n + 1
+        quality.observe_prediction(
+            get_request_id(), payload, rendered, variant=variant_label
+        )
         return json_response(200, rendered)
 
     if use_microbatch:
@@ -452,6 +471,14 @@ def create_prediction_server_app(
                     500, f"{type(value).__name__}: {value}"
                 )
             _bump_stats(t0)
+            quality.observe_prediction(
+                get_request_id(),
+                payload,
+                value,
+                variant=variant_label,
+                wave_size=meta.get("wave_size"),
+                wave_seq=meta.get("wave_seq"),
+            )
             return json_response(200, value)
 
     else:

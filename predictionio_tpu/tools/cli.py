@@ -71,9 +71,13 @@ def do_version(args) -> int:
 def do_status(args) -> int:
     """`pio status` (commands/Management.scala): storage connectivity probe,
     or — with ``--url`` — the health surface of a running daemon
-    (/healthz + /readyz + /slo.json)."""
+    (/healthz + /readyz + /slo.json + /quality.json drift state)."""
     if getattr(args, "url", None):
-        return _status_remote(args.url, getattr(args, "access_key", None))
+        return _status_remote(
+            args.url,
+            getattr(args, "access_key", None),
+            no_quality=getattr(args, "no_quality", False),
+        )
     storage = get_storage()
     import jax
 
@@ -89,12 +93,16 @@ def do_status(args) -> int:
     return 0 if all(checks.values()) else 1
 
 
-def _status_remote(url: str, access_key: str | None = None) -> int:
+def _status_remote(
+    url: str, access_key: str | None = None, no_quality: bool = False
+) -> int:
     """Read a running server's health endpoints.  Exit 0 only when the
-    daemon is alive AND ready; readiness 503s still print their body so the
-    operator sees WHICH check fails.  ``access_key`` rides as a Bearer
-    header — key-gated servers 401 /readyz and /slo.json without it
-    (/healthz alone is always open)."""
+    daemon is alive AND ready AND (unless ``--no-quality``) not drifting;
+    readiness 503s still print their body so the operator sees WHICH check
+    fails.  ``access_key`` rides as a Bearer header — key-gated servers 401
+    /readyz and /slo.json without it (/healthz alone is always open).
+    Servers without a quality surface (404/401) are simply not degraded by
+    it."""
     import urllib.error
     import urllib.request
 
@@ -119,11 +127,18 @@ def _status_remote(url: str, access_key: str | None = None) -> int:
     health_status, health = fetch("/healthz")
     ready_status, ready = fetch("/readyz")
     _slo_status, slo = fetch("/slo.json")
-    _print(
-        {"url": base, "healthz": health, "readyz": ready, "slo": slo}
-    )
+    report = {"url": base, "healthz": health, "readyz": ready, "slo": slo}
+    drifting = False
+    if not no_quality:
+        q_status, quality = fetch("/quality.json")
+        report["quality"] = quality
+        drifting = (
+            q_status == 200
+            and quality.get("drift", {}).get("state") == "drifting"
+        )
+    _print(report)
     alive = health_status == 200 and health.get("status") == "alive"
-    return 0 if alive and ready_status == 200 else 1
+    return 0 if alive and ready_status == 200 and not drifting else 1
 
 
 def do_app(args) -> int:
@@ -325,11 +340,31 @@ def do_deploy(args) -> int:
         ),
         access_key=args.accesskey or None,
     )
+    event_server = None
+    if getattr(args, "event_port", None):
+        # Embedded event server: sharing the serving process means it shares
+        # the process-global QualityMonitor, so ingested feedback events
+        # join back to THIS server's prediction log — the online-quality
+        # loop closes across one `pio deploy`.  Separate `pio eventserver`
+        # daemons each hold their own monitor and cannot see this process's
+        # predictions (drift detection still works serving-side alone).
+        from predictionio_tpu.server.event_server import create_event_server
+
+        event_server = create_event_server(
+            host=args.ip, port=args.event_port, storage=get_storage()
+        ).start_background()
+        print(
+            f"Event server (embedded, feedback joins enabled) on "
+            f"http://{args.ip}:{event_server.port}"
+        )
     print(f"Serving on http://{args.ip}:{server.port} (POST /queries.json)")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         server.shutdown()
+    finally:
+        if event_server is not None:
+            event_server.shutdown()
     return 0
 
 
@@ -617,6 +652,58 @@ def do_template(args) -> int:
     return 0
 
 
+def _fetch_url(url: str, access_key: str | None = None) -> str:
+    import urllib.request
+
+    headers = (
+        {"Authorization": f"Bearer {access_key}"} if access_key else {}
+    )
+    req = urllib.request.Request(url, headers=headers)
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.read().decode("utf-8")
+
+
+def _run_watched(label: str, render_once, watch, watch_count) -> int:
+    """Shared one-shot / ``--watch`` driver for the scrape verbs
+    (`pio metrics`, `pio quality`): one shot exits 1 on a failed scrape; a
+    watch session prints the error and keeps going (it must survive server
+    restarts), re-rendering every ``watch`` seconds until interrupted."""
+    import threading
+
+    if not watch:
+        try:
+            render_once()
+        except Exception as e:  # dead daemon: message + exit 1, no traceback
+            print(f"scrape failed: {e}", file=sys.stderr)
+            return 1
+        return 0
+    if watch < 0:
+        print("usage error: --watch must be positive", file=sys.stderr)
+        return 2
+    import datetime as _dt
+
+    # Event.wait as the timer (not a sleep poll): interruptible, and the
+    # loop body is the work — there is nothing to busy-wait on
+    pacer = threading.Event()
+    remaining = watch_count  # None = forever (operator Ctrl-C)
+    try:
+        while remaining is None or remaining > 0:
+            print(f"--- {label} @ {_dt.datetime.now().isoformat()} ---")
+            try:
+                render_once()
+            except Exception as e:  # a watch must survive server restarts
+                print(f"scrape failed: {e}", file=sys.stderr)
+            sys.stdout.flush()
+            if remaining is not None:
+                remaining -= 1
+                if remaining == 0:
+                    break
+            pacer.wait(watch)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def do_metrics(args) -> int:
     """`pio metrics`: dump the observability registry.
 
@@ -626,24 +713,15 @@ def do_metrics(args) -> int:
     DASE stage histograms, `pio eval` the fold spans).  ``--watch SECONDS``
     re-renders periodically (Ctrl-C to stop).
     """
-    import threading
 
     def render_once() -> None:
         from predictionio_tpu.obs.metrics import REGISTRY
 
         if args.url:
-            import urllib.request
-
             path = "/metrics.json" if args.json else "/metrics"
-            url = args.url.rstrip("/") + path
-            headers = (
-                {"Authorization": f"Bearer {args.access_key}"}
-                if getattr(args, "access_key", None)
-                else {}
+            body = _fetch_url(
+                args.url.rstrip("/") + path, getattr(args, "access_key", None)
             )
-            req = urllib.request.Request(url, headers=headers)
-            with urllib.request.urlopen(req, timeout=10) as r:
-                body = r.read().decode("utf-8")
             print(
                 body
                 if not args.json
@@ -654,38 +732,35 @@ def do_metrics(args) -> int:
         else:
             print(REGISTRY.render_prometheus(), end="")
 
-    if not args.watch:
-        try:
-            render_once()
-        except Exception as e:  # dead daemon: message + exit 1, no traceback
-            print(f"scrape failed: {e}", file=sys.stderr)
-            return 1
-        return 0
-    if args.watch < 0:
-        print("usage error: --watch must be positive", file=sys.stderr)
-        return 2
-    import datetime as _dt
+    return _run_watched("pio metrics", render_once, args.watch, args.watch_count)
 
-    # Event.wait as the timer (not a sleep poll): interruptible, and the
-    # loop body is the work — there is nothing to busy-wait on
-    pacer = threading.Event()
-    remaining = args.watch_count  # None = forever (operator Ctrl-C)
-    try:
-        while remaining is None or remaining > 0:
-            print(f"--- pio metrics @ {_dt.datetime.now().isoformat()} ---")
-            try:
-                render_once()
-            except Exception as e:  # a watch must survive server restarts
-                print(f"scrape failed: {e}", file=sys.stderr)
-            sys.stdout.flush()
-            if remaining is not None:
-                remaining -= 1
-                if remaining == 0:
-                    break
-            pacer.wait(args.watch)
-    except KeyboardInterrupt:
-        pass
-    return 0
+
+def do_quality(args) -> int:
+    """`pio quality`: online model-quality report.
+
+    With ``--url``, reads a running prediction server's ``/quality.json``
+    (per-variant online metrics + drift state); without it, dumps this
+    process's monitor.  ``--watch SECONDS`` mirrors `pio metrics --watch`.
+    """
+
+    def render_once() -> None:
+        from predictionio_tpu.obs.quality import (
+            default_quality,
+            render_quality_text,
+        )
+
+        if args.url:
+            snap = json.loads(
+                _fetch_url(
+                    args.url.rstrip("/") + "/quality.json",
+                    getattr(args, "access_key", None),
+                )
+            )
+        else:
+            snap = default_quality().snapshot()
+        print(json.dumps(snap, indent=2) if args.json else render_quality_text(snap))
+
+    return _run_watched("pio quality", render_once, args.watch, args.watch_count)
 
 
 def do_check(args) -> int:
@@ -815,6 +890,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="access key for key-gated servers (sent as a Bearer header; "
         "/healthz alone answers without it)",
     )
+    stt.add_argument(
+        "--no-quality",
+        action="store_true",
+        help="do not fold /quality.json drift state into the exit code "
+        "(by default a 'drifting' model degrades status to exit 1)",
+    )
     stt.set_defaults(fn=do_status)
 
     ap = sub.add_parser("app")
@@ -903,6 +984,14 @@ def build_parser() -> argparse.ArgumentParser:
     dp.add_argument("--ip", default="0.0.0.0")
     dp.add_argument("--port", type=int, default=8000)
     dp.add_argument("--feedback", action="store_true")
+    dp.add_argument(
+        "--event-port",
+        type=int,
+        default=None,
+        help="also serve an embedded event server on this port; feedback "
+        "events it ingests join back to this server's prediction log "
+        "(the online model-quality loop in one process)",
+    )
     dp.add_argument("--accesskey", default="")
     dp.add_argument(
         "--no-check",
@@ -1017,6 +1106,40 @@ def build_parser() -> argparse.ArgumentParser:
         help=argparse.SUPPRESS,  # bounded --watch iterations (tests)
     )
     mt.set_defaults(fn=do_metrics)
+
+    ql = sub.add_parser(
+        "quality",
+        description="Online model quality: per-variant rolling metrics "
+        "(CTR / hit rate / precision@k / rating MAE) and drift state "
+        "(PSI/KS vs the reference window), from a running server's "
+        "/quality.json or this process's monitor.",
+    )
+    ql.add_argument(
+        "--url", help="read a running server (e.g. http://127.0.0.1:8000)"
+    )
+    ql.add_argument(
+        "--json", action="store_true", help="raw /quality.json instead of "
+        "the text summary"
+    )
+    ql.add_argument(
+        "--access-key",
+        default=None,
+        help="access key for key-gated servers (sent as a Bearer header)",
+    )
+    ql.add_argument(
+        "--watch",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="re-render every SECONDS until interrupted",
+    )
+    ql.add_argument(
+        "--watch-count",
+        type=int,
+        default=None,
+        help=argparse.SUPPRESS,  # bounded --watch iterations (tests)
+    )
+    ql.set_defaults(fn=do_quality)
 
     ck = sub.add_parser(
         "check",

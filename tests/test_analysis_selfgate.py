@@ -57,6 +57,13 @@ def test_baseline_entries_are_justified():
         )
 
 
+def test_baseline_did_not_grow():
+    """The model-quality subsystem (obs/quality.py + its wiring) landed
+    with ZERO new baseline entries: the justified baseline stays at the
+    13 entries PR 2 curated."""
+    assert len(Baseline.load(BASELINE).entries) == 13
+
+
 def test_baseline_has_no_stale_entries():
     """Every baseline entry still matches a real finding — entries for
     since-fixed code must be deleted, not accumulate."""
@@ -88,6 +95,22 @@ def test_obs_modules_lint_clean():
     assert report.errors == []
     assert report.findings == [], "\n".join(f.text() for f in report.findings)
     assert report.pragma_suppressed == 0
+
+
+def test_quality_module_lint_clean_with_zero_pragmas():
+    """The online model-quality module runs on the serving hot path
+    (observe_prediction per request) and the ingest path (observe_feedback
+    per event): it must be `pio check`-clean with NO pragma suppressions
+    and NO baseline entries — same bar as the rest of obs/."""
+    report = analyze_paths([PACKAGE / "obs" / "quality.py"], root=REPO_ROOT)
+    assert report.errors == []
+    assert report.findings == [], "\n".join(f.text() for f in report.findings)
+    assert report.pragma_suppressed == 0
+    quality_file = "predictionio_tpu/obs/quality.py"
+    baselined = [
+        e for e in Baseline.load(BASELINE).entries if e.file == quality_file
+    ]
+    assert baselined == []
 
 
 def test_profiler_capture_runs_off_request_thread():

@@ -15,6 +15,7 @@ import pytest
 from predictionio_tpu.obs.metrics import (
     LATENCY_BUCKETS,
     SIZE_BUCKETS,
+    MetricsHistory,
     MetricsRegistry,
     quantile_from_buckets,
 )
@@ -342,6 +343,58 @@ class TestServerMetricsRoutes:
         assert "<h2>Metrics</h2>" in r.body
         assert "pio_dash_probe_total" in r.body
         assert app.handle(Request("GET", "/metrics", {}, {})).status == 200
+
+
+class TestMetricsHistory:
+    """Satellite: the bounded per-metric history ring sampled on scrape,
+    powering the dashboard sparklines."""
+
+    def test_depth_bound_and_order(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("pio_hist_probe", "p")
+        hist = MetricsHistory(depth=4)
+        for i in range(10):
+            g.set(float(i))
+            hist.sample(reg)
+        values = hist.series("pio_hist_probe")
+        assert values == [6.0, 7.0, 8.0, 9.0]  # fixed depth, oldest first
+
+    def test_histogram_series_samples_p95(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("pio_hist_lat_seconds", "l")
+        for _ in range(100):
+            h.observe(0.01)
+        hist = MetricsHistory(depth=8)
+        hist.sample(reg)
+        (p95,) = hist.series("pio_hist_lat_seconds")
+        assert p95 == pytest.approx(h.quantile(0.95))
+
+    def test_labeled_series_and_items(self):
+        reg = MetricsRegistry()
+        fam = reg.counter("pio_hist_reqs_total", "r", labelnames=("route",))
+        fam.labels("/a").inc(2)
+        fam.labels("/b").inc(5)
+        hist = MetricsHistory()
+        hist.sample(reg)
+        assert hist.series("pio_hist_reqs_total", ("/a",)) == [2.0]
+        assert hist.items("pio_hist_reqs_total") == [
+            (("/a",), [2.0]),
+            (("/b",), [5.0]),
+        ]
+        assert hist.series("pio_hist_reqs_total", ("missing",)) == []
+
+    def test_registry_owns_a_history_fed_on_scrape(self):
+        """GET /metrics advances the registry's own history ring."""
+        from predictionio_tpu.obs.http import add_observability_routes
+        from predictionio_tpu.server.httpd import HTTPApp, Request
+
+        reg = MetricsRegistry()
+        reg.gauge("pio_hist_scrape_probe", "p").set(7)
+        app = HTTPApp("histtest")
+        add_observability_routes(app, reg)
+        assert app.handle(Request("GET", "/metrics", {}, {})).status == 200
+        assert app.handle(Request("GET", "/metrics.json", {}, {})).status == 200
+        assert reg.history.series("pio_hist_scrape_probe") == [7.0, 7.0]
 
 
 class TestMetricsSnifferPlugin:

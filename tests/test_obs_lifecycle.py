@@ -969,6 +969,7 @@ class TestEndToEndCorrelation:
         assert entry["path"] == "/queries.json"
         assert "queue_wait_s" in entry and "device_s" in entry
         assert entry["wave_request_ids"] == [slow_rid]
+        assert entry["wave_seq"] >= 1  # which dispatch wave served it
         assert entry["span"]["request_id"] == slow_rid
         assert entry["payload_bytes"] > 0 and entry["response_bytes"] > 0
 

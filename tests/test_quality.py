@@ -1,0 +1,979 @@
+"""Online model-quality observability: histogram sketches + PSI/KS math,
+drift detection (stable soak must never alert, injected covariate shift
+must flip the detector), prediction logging + feedback joins with online
+metrics, the /quality.json surface, CLI verbs, the dashboard panel, and
+the acceptance e2e that closes the loop through the aio front end and real
+event-server ingest."""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+import time
+import types
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data import DataMap, Event
+from predictionio_tpu.obs import quality as quality_mod
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.quality import (
+    DRIFTING,
+    OK,
+    WARNING,
+    DriftDetector,
+    HistogramSketch,
+    OnlinePrecisionAtK,
+    QualityMonitor,
+    ks_statistic,
+    psi_statistic,
+    render_quality_text,
+    summarize_prediction,
+    summarize_query,
+)
+from predictionio_tpu.server.httpd import HTTPApp, Request
+
+
+def _sketch(values, lo=0.0, hi=1.0, n_bins=4) -> HistogramSketch:
+    s = HistogramSketch(lo, hi, n_bins)
+    for v in values:
+        s.update(v)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# sketch + divergence statistics
+# ---------------------------------------------------------------------------
+
+
+class TestHistogramSketch:
+    def test_binning_and_overflow(self):
+        s = HistogramSketch(0.0, 1.0, n_bins=4)
+        for v in (-1.0, 0.0, 0.24, 0.26, 0.99, 1.0, 5.0):
+            s.update(v)
+        # [underflow, b0, b1, b2, b3, overflow]
+        assert s.counts == [1, 2, 1, 0, 1, 2]
+        assert s.total == 7
+
+    def test_probabilities_sum_to_one(self):
+        s = _sketch([0.1, 0.2, 0.9])
+        assert sum(s.probabilities()) == pytest.approx(1.0)
+        assert sum(s.probabilities(alpha=0.5)) == pytest.approx(1.0)
+
+    def test_rejects_empty_range(self):
+        with pytest.raises(ValueError):
+            HistogramSketch(1.0, 1.0)
+
+    def test_psi_identical_is_zero(self):
+        a = _sketch([0.1, 0.3, 0.5, 0.7] * 50)
+        b = _sketch([0.1, 0.3, 0.5, 0.7] * 50)
+        assert psi_statistic(a, b) == pytest.approx(0.0, abs=1e-12)
+        assert ks_statistic(a, b) == pytest.approx(0.0, abs=1e-12)
+
+    def test_psi_and_ks_grow_with_separation(self):
+        ref = _sketch([0.1] * 100)
+        near = _sketch([0.1] * 90 + [0.6] * 10)
+        far = _sketch([0.9] * 100)
+        assert 0 < psi_statistic(ref, near) < psi_statistic(ref, far)
+        assert 0 < ks_statistic(ref, near) < ks_statistic(ref, far)
+        assert ks_statistic(ref, far) == pytest.approx(1.0)
+
+    def test_ks_exact_value(self):
+        # half the mass moved one bin to the right -> max CDF gap is 0.5
+        ref = _sketch([0.1] * 100, n_bins=2)
+        cur = _sketch([0.1] * 50 + [0.9] * 50, n_bins=2)
+        assert ks_statistic(ref, cur) == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# drift detector: thresholds, hysteresis, soak, shift
+# ---------------------------------------------------------------------------
+
+
+class TestDriftDetectorThresholds:
+    """Exact-threshold assertions on the state classifier: the effective
+    thresholds are the configured PSI/KS values plus the window's
+    sampling-noise floor, and hysteresis widens the downward path."""
+
+    def _det(self) -> DriftDetector:
+        d = DriftDetector("t", window=100, n_bins=8)
+        assert d.psi_floor == pytest.approx(2.5 * 9 / 100)
+        assert d.ks_floor == pytest.approx(1.1 * (2.0 / 100) ** 0.5)
+        return d
+
+    def test_enter_thresholds_exact(self):
+        d = self._det()
+        warn = d.psi_warn + d.psi_floor
+        drift = d.psi_drift + d.psi_floor
+        eps = 1e-9
+        assert d.classify(warn - eps, 0.0) == OK
+        assert d.classify(warn, 0.0) == WARNING
+        assert d.classify(drift - eps, 0.0) == WARNING
+        assert d.classify(drift, 0.0) == DRIFTING
+        ks_drift = d.ks_drift + d.ks_floor
+        assert d.classify(0.0, ks_drift - eps) == WARNING  # ks_warn < x < drift
+        assert d.classify(0.0, ks_drift) == DRIFTING
+
+    def test_exit_hysteresis_band(self):
+        d = self._det()
+        d.state = DRIFTING
+        drift = d.psi_drift + d.psi_floor
+        # inside the band [0.8*drift, drift): stays DRIFTING
+        assert d.classify(drift * 0.9, 0.0) == DRIFTING
+        # below the exit bar: argues for de-escalation
+        assert d.classify(drift * 0.79, 0.0) == WARNING
+
+    def test_patience_blocks_single_window_blip(self):
+        d = DriftDetector("t", window=4, n_bins=2, patience=2)
+        stable = [0.1, 0.4, 0.6, 0.9]
+        for v in stable:  # seed the reference
+            d.update(v)
+        assert d.reference is not None and d.state == OK
+        for v in [100.0] * 4:  # ONE wildly-shifted window
+            out = d.update(v)
+        assert out is not None and out["changed"] is None
+        assert d.state == OK  # patience=2: a single window cannot flip
+        for v in stable * 1:  # a clean window resets the pending streak
+            d.update(v)
+        for v in [100.0] * 4:
+            d.update(v)
+        assert d.state == OK  # non-consecutive breaches never accumulate
+        for v in [100.0] * 4:  # second CONSECUTIVE breach escalates
+            out = d.update(v)
+        assert d.state == DRIFTING
+        assert out["changed"] is not None
+        assert d.transitions == 1
+
+    def test_stable_soak_never_alerts(self):
+        """A stationary stream must never reach `drifting` — zero alert
+        transitions over 70+ windows (seeded, deterministic)."""
+        rng = random.Random(11)
+        d = DriftDetector("t", window=100, n_bins=10)
+        for _ in range(72 * 100):
+            d.update(rng.gauss(10.0, 1.0))
+        assert d.windows >= 70
+        assert d.state == OK
+        assert d.transitions == 0
+
+    def test_covariate_shift_flips_within_patience_windows(self):
+        """An injected mean shift must flip the detector within
+        patience + 1 completed windows, with PSI far above the effective
+        drifting threshold."""
+        rng = random.Random(5)
+        d = DriftDetector("t", window=100, n_bins=10, patience=2)
+        for _ in range(300):  # reference + 2 stable windows
+            d.update(rng.gauss(10.0, 1.0))
+        assert d.state == OK
+        windows_before = d.windows
+        while d.state != DRIFTING:
+            out = d.update(rng.gauss(50.0, 1.0))
+            assert d.windows - windows_before <= d.patience + 1, (
+                "detector did not flip within patience+1 shifted windows"
+            )
+        assert d.last_psi >= d.psi_drift + d.psi_floor
+        assert d.last_ks >= d.ks_drift + d.ks_floor
+
+    def test_non_finite_values_cannot_poison_the_detector(self):
+        """json.loads accepts NaN/Infinity literals, so hostile query
+        features reach the detector: they must be skipped — a NaN in the
+        seed window used to make sketch construction raise on EVERY later
+        request (unbounded seed growth, drift permanently disabled)."""
+        d = DriftDetector("t", window=4, n_bins=2, patience=1)
+        for v in [0.1, float("nan"), 0.4, float("inf"), 0.6, 0.9]:
+            d.update(v)
+        assert d.reference is not None  # finite values completed the seed
+        assert d._seed is None  # seed buffer released, no unbounded growth
+        d.update(float("nan"))  # post-reference NaN: ignored, not a crash
+        assert d.current.total == 0
+        for v in [100.0] * 8:  # detection still works afterwards
+            d.update(v)
+        assert d.state == DRIFTING
+
+    def test_recovery_after_shift_ends(self):
+        rng = random.Random(9)
+        d = DriftDetector("t", window=50, n_bins=8, patience=2)
+        for _ in range(150):
+            d.update(rng.gauss(0.0, 1.0))
+        for _ in range(200):
+            d.update(rng.gauss(25.0, 1.0))
+        assert d.state == DRIFTING
+        for _ in range(400):  # distribution returns to the reference
+            d.update(rng.gauss(0.0, 1.0))
+        assert d.state == OK
+        assert d.transitions >= 2  # up and back down
+
+
+# ---------------------------------------------------------------------------
+# summarizers
+# ---------------------------------------------------------------------------
+
+
+class TestSummarizers:
+    def test_query_features_numeric_only_and_entity(self):
+        features, entity = summarize_query(
+            {"user": "u1", "num": 10, "threshold": 0.5, "flag": True, "s": "x"}
+        )
+        assert features == {"num": 10.0, "threshold": 0.5}
+        assert entity == "u1"
+
+    def test_query_feature_cap(self):
+        payload = {f"f{i:02d}": float(i) for i in range(20)}
+        features, _ = summarize_query(payload)
+        assert len(features) == quality_mod._MAX_QUERY_FEATURES
+
+    def test_non_dict_payload_safe(self):
+        assert summarize_query([1, 2, 3]) == ({}, None)
+
+    def test_item_scores_shapes(self):
+        top, scores, raw = summarize_prediction(
+            {"itemScores": [{"item": "a", "score": 0.9}, {"item": "b", "score": 0.7}]}
+        )
+        assert top == ("a", "b")
+        assert scores == {"a": 0.9, "b": 0.7}
+        assert raw == [0.9, 0.7]
+        top2, _, _ = summarize_prediction(
+            {"item_scores": [{"item": "c", "score": 1.0}]}
+        )
+        assert top2 == ("c",)
+
+    def test_classification_shape(self):
+        top, scores, raw = summarize_prediction({"label": "spam", "score": 0.93})
+        assert top == ("spam",)
+        assert raw == [0.93]
+
+    def test_unknown_shape_degrades_to_empty(self):
+        assert summarize_prediction({"echo": "u1"}) == ((), {}, [])
+        assert summarize_prediction("plain string") == ((), {}, [])
+
+
+# ---------------------------------------------------------------------------
+# monitor: prediction log, joins, online metrics
+# ---------------------------------------------------------------------------
+
+
+def _monitor(**kw) -> QualityMonitor:
+    defaults = dict(
+        registry=MetricsRegistry(),
+        capacity=64,
+        feedback_events=("rate", "buy"),
+        join_window_s=100.0,
+        drift_window=1000,  # effectively off for join-focused tests
+    )
+    defaults.update(kw)
+    return QualityMonitor(**defaults)
+
+
+def _predict(m, rid, user="u1", items=("i1", "i2", "i3"), ts=None, **extra):
+    m.observe_prediction(
+        rid,
+        {"user": user, "num": 10},
+        {"itemScores": [
+            {"item": i, "score": 1.0 - n * 0.1} for n, i in enumerate(items)
+        ]},
+        ts=ts,
+        **extra,
+    )
+
+
+def _feedback(event="rate", user="u1", item="i2", rating=None, pr_id=None):
+    props = {} if rating is None else {"rating": rating}
+    return Event(
+        event=event,
+        entity_type="user",
+        entity_id=user,
+        target_entity_type="item",
+        target_entity_id=item,
+        properties=DataMap(props),
+        pr_id=pr_id,
+    )
+
+
+class TestPredictionLogAndJoins:
+    def test_ring_bounded_with_index_cleanup(self):
+        m = _monitor(capacity=8)
+        for i in range(50):
+            _predict(m, f"r{i}", user=f"u{i}")
+        snap = m.snapshot()
+        assert snap["log"]["size"] == 8
+        assert len(m._by_rid) == 8 and len(m._by_entity) == 8
+        # evicted predictions are no longer joinable
+        assert m.observe_feedback(_feedback(user="u0"), request_id="r0") is False
+        assert m.observe_feedback(_feedback(user="u49"), request_id="r49") is True
+
+    def test_join_on_request_id(self):
+        m = _monitor()
+        _predict(m, "rid-1")
+        assert m.observe_feedback(_feedback(), request_id="rid-1") is True
+        v = m.snapshot()["variants"]["default"]
+        assert v["joined"] == 1
+        # i2 is in the top-3 -> hit rate 1, precision 1/min(10,1)=1
+        assert v["metrics"]["hit_rate"] == 1.0
+        assert v["metrics"]["precision_at_k"] == 1.0
+
+    def test_join_on_pr_id_when_header_id_is_minted(self):
+        """The ingest front end always MINTS a request id; when it matches
+        no prediction the joiner must fall through to the event's prId."""
+        m = _monitor()
+        _predict(m, "rid-2", user="someone-else")
+        ok = m.observe_feedback(
+            _feedback(user="nobody", pr_id="rid-2"), request_id="minted-xyz"
+        )
+        assert ok is True
+
+    def test_join_on_pio_request_id_property(self):
+        m = _monitor()
+        _predict(m, "rid-3", user="other")
+        ev = Event(
+            event="rate", entity_type="user", entity_id="nobody",
+            target_entity_type="item", target_entity_id="i1",
+            properties=DataMap({"pioRequestId": "rid-3"}),
+        )
+        assert m.observe_feedback(ev) is True
+
+    def test_join_on_entity_within_window(self, monkeypatch):
+        m = _monitor(join_window_s=60.0)
+        t = {"now": 1000.0}
+        monkeypatch.setattr(quality_mod, "_now", lambda: t["now"])
+        _predict(m, "rid-4", user="u9")
+        t["now"] += 30.0  # inside the window
+        assert m.observe_feedback(_feedback(user="u9")) is True
+        reg_joined = m._m_joined.labels("default", "entity")
+        assert reg_joined.value == 1
+
+    def test_entity_join_outside_window_is_unjoined(self, monkeypatch):
+        m = _monitor(join_window_s=60.0)
+        t = {"now": 1000.0}
+        monkeypatch.setattr(quality_mod, "_now", lambda: t["now"])
+        _predict(m, "rid-5", user="u9")
+        t["now"] += 120.0  # join window expired
+        assert m.observe_feedback(_feedback(user="u9")) is False
+        assert m._m_unjoined.value == 1
+
+    def test_non_feedback_event_ignored(self):
+        m = _monitor(feedback_events=("rate",))
+        _predict(m, "rid-6")
+        assert m.is_feedback("rate") and not m.is_feedback("$set")
+        ev = _feedback(event="$set")
+        assert m.observe_feedback(ev, request_id="rid-6") is False
+
+    def test_rating_mae(self):
+        m = _monitor()
+        _predict(m, "rid-7", items=("i1", "i2"))  # scores 1.0, 0.9
+        m.observe_feedback(_feedback(item="i2", rating=4.0), request_id="rid-7")
+        v = m.snapshot()["variants"]["default"]
+        assert v["metrics"]["rating_mae"] == pytest.approx(abs(0.9 - 4.0))
+
+    def test_multiple_feedback_accumulates_one_join(self):
+        m = _monitor()
+        _predict(m, "rid-8", items=("i1", "i2", "i3"))
+        m.observe_feedback(_feedback(item="i2"), request_id="rid-8")
+        m.observe_feedback(_feedback(item="i9"), request_id="rid-8")
+        v = m.snapshot()["variants"]["default"]
+        assert v["joined"] == 1  # one prediction joined, twice fed back
+        assert v["feedback_events"] == 2
+        # precision: top-10 hits {i2} of actual {i2, i9} -> 1/min(10,2)
+        assert v["metrics"]["precision_at_k"] == pytest.approx(0.5)
+
+    def test_ctr_is_rolling_fraction_of_predictions(self):
+        m = _monitor()
+        for i in range(10):
+            _predict(m, f"c{i}", user=f"cu{i}")
+        m.observe_feedback(_feedback(user="cu3"), request_id="c3")
+        v = m.snapshot()["variants"]["default"]
+        assert v["metrics"]["ctr"] == pytest.approx(0.1)
+
+    def test_per_variant_isolation(self):
+        m = _monitor()
+        _predict(m, "va-1", user="u1", variant="A")
+        _predict(m, "vb-1", user="u2", variant="B")
+        m.observe_feedback(_feedback(user="u1"), request_id="va-1")
+        snap = m.snapshot()
+        assert snap["variants"]["A"]["joined"] == 1
+        assert snap["variants"]["B"]["joined"] == 0
+
+    def test_online_metric_gauges_exported(self):
+        reg = MetricsRegistry()
+        m = _monitor(registry=reg)
+        _predict(m, "g1")
+        m.observe_feedback(_feedback(), request_id="g1")
+        fam = reg.get("pio_online_metric")
+        series = {lv: child.value for lv, child in fam.series()}
+        assert series[("default", "hit_rate")] == 1.0
+        assert series[("default", "joined_in_window")] == 1.0
+        assert ("default", "ctr") in series
+
+    def test_scrape_refresh_unfreezes_gauges_after_feedback_stops(
+        self, monkeypatch
+    ):
+        """A dead feedback pipeline must be VISIBLE on the metrics surface:
+        once the join window drains, a /metrics scrape (refresh_gauges)
+        drives ctr and joined_in_window back to 0 instead of freezing them
+        at the last healthy value."""
+        from predictionio_tpu.obs.http import add_observability_routes
+
+        t = {"now": 1000.0}
+        monkeypatch.setattr(quality_mod, "_now", lambda: t["now"])
+        reg = MetricsRegistry()
+        m = _monitor(registry=reg, join_window_s=60.0)
+        app = HTTPApp("freshtest")
+        add_observability_routes(app, reg, quality=m)
+        _predict(m, "f1")
+        m.observe_feedback(_feedback(), request_id="f1")
+        fam = reg.get("pio_online_metric")
+        assert fam.labels("default", "ctr").value == 1.0
+        t["now"] += 120.0  # joins age out; KEEP predicting, no feedback
+        _predict(m, "f2", user="u2")
+        assert app.handle(Request("GET", "/metrics", {}, {})).status == 200
+        assert fam.labels("default", "ctr").value == 0.0
+        assert fam.labels("default", "joined_in_window").value == 0.0
+        # ratio metrics keep their last value; joined_in_window == 0 is
+        # the staleness signal
+        assert fam.labels("default", "hit_rate").value == 1.0
+
+    def test_telemetry_never_raises(self):
+        m = _monitor()
+        # hostile payloads must be absorbed, not raised
+        m.observe_prediction("x", object(), object())
+        assert m.observe_feedback(object()) is False
+
+
+class TestOfflineOnlineComparability:
+    def test_precision_matches_offline_metric(self):
+        """The online precision@k must produce the SAME number as the
+        offline PrecisionAtK for an equivalent prediction/actual pair —
+        that is the point of reusing the core.metric reducers."""
+        from predictionio_tpu.models.recommendation.engine import (
+            ItemScore,
+            PredictedResult,
+        )
+        from predictionio_tpu.models.recommendation.evaluation import (
+            PrecisionAtK,
+        )
+
+        predicted = PredictedResult(
+            item_scores=tuple(
+                ItemScore(item=f"i{j}", score=1.0 - j * 0.1) for j in range(5)
+            )
+        )
+        actual = frozenset({"i1", "i3", "i77"})
+        offline = PrecisionAtK(k=3).calculate(
+            [(None, [(None, predicted, actual)])]
+        )
+        top, scores, _ = summarize_prediction(predicted.to_json_dict(), k=3)
+        online = OnlinePrecisionAtK(k=3).calculate(
+            [(None, [(None, {"top": top, "scores": scores}, dict.fromkeys(actual))])]
+        )
+        assert online == pytest.approx(offline)
+
+
+# ---------------------------------------------------------------------------
+# overhead bound (PR1-style)
+# ---------------------------------------------------------------------------
+
+
+class TestOverhead:
+    def test_observe_prediction_under_50us(self):
+        """PredictionLog append + query/prediction summarization + sketch
+        updates must stay far under the 50 µs per-request budget."""
+        m = QualityMonitor(registry=MetricsRegistry(), drift_window=256)
+        payload = {"user": "u1", "num": 10}
+        rendered = {
+            "itemScores": [
+                {"item": f"i{j}", "score": 1.0 - j * 0.05} for j in range(10)
+            ]
+        }
+        m.observe_prediction("warm", payload, rendered)  # warm the path
+        # best-of-3 batches: the bound is on the code's cost, so take the
+        # least-interfered measurement — a single long loop is at the mercy
+        # of scheduler jitter on a loaded CI machine
+        n, best = 2000, float("inf")
+        for batch in range(3):
+            t0 = time.perf_counter()
+            for i in range(n):
+                m.observe_prediction(f"b{batch}-r{i}", payload, rendered)
+            best = min(best, (time.perf_counter() - t0) / n)
+        assert best < 50e-6, f"observe_prediction cost {best * 1e6:.2f}µs"
+
+
+# ---------------------------------------------------------------------------
+# routes: /quality.json on servers, gating
+# ---------------------------------------------------------------------------
+
+
+class TestQualityRoutes:
+    def _app(self, access_key=None, quality=None):
+        from predictionio_tpu.obs.http import add_observability_routes
+
+        app = HTTPApp("qtest")
+        add_observability_routes(
+            app,
+            MetricsRegistry(),
+            access_key=access_key,
+            quality=quality or _monitor(),
+        )
+        return app
+
+    def test_quality_json_served(self):
+        app = self._app()
+        _predict(app.quality, "q1")
+        r = app.handle(Request("GET", "/quality.json", {}, {}))
+        assert r.status == 200
+        body = json.loads(r.encoded()[0])
+        assert body["variants"]["default"]["predictions"] == 1
+        assert body["drift"]["state"] == "ok"
+
+    def test_quality_json_gated_like_debug_routes(self):
+        app = self._app(access_key="qk")
+        assert (
+            app.handle(Request("GET", "/quality.json", {}, {})).status == 401
+        )
+        assert (
+            app.handle(
+                Request("GET", "/quality.json", {"accessKey": "qk"}, {})
+            ).status
+            == 200
+        )
+
+    def test_prediction_server_serves_quality(self):
+        from predictionio_tpu.server.prediction_server import (
+            create_prediction_server_app,
+        )
+
+        app = create_prediction_server_app(
+            _stub_deployed(),
+            registry=MetricsRegistry(),
+        )
+        r = app.handle(Request("GET", "/quality.json", {}, {}))
+        assert r.status == 200
+
+    def test_event_server_hides_quality_without_obs_key(self, storage):
+        from predictionio_tpu.server.event_server import (
+            create_event_server_app,
+        )
+
+        app = create_event_server_app(storage, registry=MetricsRegistry())
+        assert (
+            app.handle(Request("GET", "/quality.json", {}, {})).status == 404
+        )
+        gated = create_event_server_app(
+            storage, registry=MetricsRegistry(), obs_access_key="ok1"
+        )
+        assert (
+            gated.handle(Request("GET", "/quality.json", {}, {})).status == 401
+        )
+        assert (
+            gated.handle(
+                Request("GET", "/quality.json", {"accessKey": "ok1"}, {})
+            ).status
+            == 200
+        )
+
+    def test_default_monitors_shared_in_process(self, storage):
+        """The invariant `pio deploy --event-port` relies on: a prediction
+        server and an event server built in one process on the default
+        registry share ONE monitor, so ingested feedback joins back to the
+        served predictions with zero wiring."""
+        from predictionio_tpu.server.event_server import (
+            create_event_server_app,
+        )
+        from predictionio_tpu.server.prediction_server import (
+            create_prediction_server_app,
+        )
+
+        pred_app = create_prediction_server_app(_stub_deployed())
+        event_app = create_event_server_app(storage)
+        assert pred_app.quality is event_app.quality
+
+    def test_deploy_parser_accepts_event_port(self):
+        from predictionio_tpu.tools.cli import build_parser
+
+        args = build_parser().parse_args(
+            ["deploy", "--engine", "x", "--port", "0", "--event-port", "7071"]
+        )
+        assert args.event_port == 7071
+
+    def test_event_server_ingest_feeds_joiner(self, storage):
+        """Feedback through the real ingest route (POST /events.json) joins
+        back to a logged prediction on the shared monitor."""
+        from predictionio_tpu.server.event_server import (
+            create_event_server_app,
+        )
+        from predictionio_tpu.tools import commands as cmd
+
+        monitor = _monitor()
+        d = cmd.app_new(storage, "qualapp")
+        app = create_event_server_app(
+            storage, registry=MetricsRegistry(), quality=monitor
+        )
+        _predict(monitor, "ev-rid-1", user="u1", items=("i1", "i2"))
+        body = json.dumps(
+            {
+                "event": "rate",
+                "entityType": "user",
+                "entityId": "u1",
+                "targetEntityType": "item",
+                "targetEntityId": "i2",
+                "properties": {"rating": 5.0},
+                "prId": "ev-rid-1",
+            }
+        ).encode()
+        r = app.handle(
+            Request(
+                "POST", "/events.json", {"accessKey": d.keys[0].key}, {}, body
+            )
+        )
+        assert r.status == 201
+        v = monitor.snapshot()["variants"]["default"]
+        assert v["joined"] == 1
+        assert v["metrics"]["hit_rate"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# dashboard panel
+# ---------------------------------------------------------------------------
+
+
+class TestDashboardQualityPanel:
+    def test_panel_renders_with_sparklines(self, storage):
+        from predictionio_tpu.obs.metrics import REGISTRY
+        from predictionio_tpu.server.dashboard import create_dashboard_app
+
+        monitor = _monitor(registry=REGISTRY)
+        _predict(monitor, "dash-1")
+        monitor.observe_feedback(_feedback(), request_id="dash-1")
+        REGISTRY.history.sample(REGISTRY)  # one pre-render scrape tick
+        app = create_dashboard_app(storage, quality=monitor)
+        page = app.handle(Request("GET", "/", {}, {})).body
+        assert "<h2>Model quality</h2>" in page
+        assert "hit_rate" in page
+        assert "prediction log" in page
+        # the metrics table grew a trend column fed by the history ring
+        assert "<th>trend</th>" in page
+        # the render sampled AFTER refreshing the quality gauges, so the
+        # trend tail agrees with the value column instead of lagging
+        tail = REGISTRY.history.series(
+            "pio_online_metric", ("default", "hit_rate")
+        )
+        assert tail and tail[-1] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# CLI: pio quality, pio status drift fold
+# ---------------------------------------------------------------------------
+
+
+def _quality_server(monitor):
+    from predictionio_tpu.obs.http import add_observability_routes
+    from predictionio_tpu.server.httpd import AppServer
+
+    app = HTTPApp("qcli")
+    add_observability_routes(
+        app, MetricsRegistry(), quality=monitor, readiness={"dep": lambda: True}
+    )
+    return AppServer(app, "127.0.0.1", 0).start_background()
+
+
+def _drifting_monitor() -> QualityMonitor:
+    """A monitor driven into the drifting state with a tiny window."""
+    m = _monitor(drift_window=20)
+    rng = random.Random(4)
+    for i in range(200):
+        _predict(m, f"s{i}", user=f"u{i}")
+        m.observe_prediction(f"n{i}", {"num": rng.gauss(0, 1)}, {})
+    for i in range(200, 400):
+        m.observe_prediction(f"n{i}", {"num": rng.gauss(1000, 1)}, {})
+    assert m.drift_state() == "drifting"
+    return m
+
+
+class TestCLIQuality:
+    def test_quality_local_dump(self, capsys, monkeypatch):
+        from predictionio_tpu.tools.cli import main as cli_main
+
+        monitor = _monitor()
+        _predict(monitor, "cli-1")
+        monkeypatch.setattr(
+            "predictionio_tpu.obs.quality.default_quality", lambda: monitor
+        )
+        assert cli_main(["quality"]) == 0
+        out = capsys.readouterr().out
+        assert "drift: ok" in out
+        assert "variant default" in out
+
+    def test_quality_url_json(self, capsys):
+        from predictionio_tpu.tools.cli import main as cli_main
+
+        monitor = _monitor()
+        _predict(monitor, "cli-2")
+        server = _quality_server(monitor)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            assert cli_main(["quality", "--url", base, "--json"]) == 0
+            out = json.loads(capsys.readouterr().out)
+            assert out["variants"]["default"]["predictions"] == 1
+        finally:
+            server.shutdown()
+
+    def test_quality_watch_rerenders(self, capsys, monkeypatch):
+        from predictionio_tpu.tools.cli import main as cli_main
+
+        monkeypatch.setattr(
+            "predictionio_tpu.obs.quality.default_quality", lambda: _monitor()
+        )
+        assert (
+            cli_main(["quality", "--watch", "0.01", "--watch-count", "3"]) == 0
+        )
+        assert capsys.readouterr().out.count("--- pio quality @") == 3
+
+    def test_quality_unreachable_exits_1(self, capsys):
+        import socket
+
+        from predictionio_tpu.tools.cli import main as cli_main
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        assert cli_main(["quality", "--url", f"http://127.0.0.1:{port}"]) == 1
+        assert "scrape failed" in capsys.readouterr().err
+
+    def test_status_degrades_on_drifting(self, capsys):
+        from predictionio_tpu.tools.cli import main as cli_main
+
+        server = _quality_server(_drifting_monitor())
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            assert cli_main(["status", "--url", base]) == 1
+            out = json.loads(capsys.readouterr().out)
+            assert out["quality"]["drift"]["state"] == "drifting"
+            # opt-out flag: health is fine, so status passes again
+            assert cli_main(["status", "--url", base, "--no-quality"]) == 0
+            out = json.loads(capsys.readouterr().out)
+            assert "quality" not in out
+        finally:
+            server.shutdown()
+
+    def test_status_ok_when_quality_ok(self, capsys):
+        from predictionio_tpu.tools.cli import main as cli_main
+
+        monitor = _monitor()
+        _predict(monitor, "st-1")
+        server = _quality_server(monitor)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            assert cli_main(["status", "--url", base]) == 0
+            out = json.loads(capsys.readouterr().out)
+            assert out["quality"]["drift"]["state"] == "ok"
+        finally:
+            server.shutdown()
+
+    def test_status_tolerates_missing_quality_surface(self, capsys):
+        """A server without /quality.json (404) must not degrade status."""
+        from predictionio_tpu.obs.http import add_observability_routes
+        from predictionio_tpu.server.httpd import AppServer
+        from predictionio_tpu.tools.cli import main as cli_main
+
+        app = HTTPApp("noq")
+        add_observability_routes(
+            app, MetricsRegistry(), readiness={"dep": lambda: True}
+        )
+        server = AppServer(app, "127.0.0.1", 0).start_background()
+        try:
+            assert (
+                cli_main(["status", "--url", f"http://127.0.0.1:{server.port}"])
+                == 0
+            )
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# acceptance e2e: serve -> feedback -> /quality.json -> drift -> /metrics
+# ---------------------------------------------------------------------------
+
+
+def _stub_deployed():
+    """A DeployedEngine without storage/training: ranked echo algorithm."""
+    import threading
+
+    from predictionio_tpu.core.base import Algorithm, FirstServing
+
+    class RankedEcho(Algorithm):
+        def train(self, ctx, pd):
+            return None
+
+        def predict(self, model, q):
+            user = q.get("user", "?")
+            return {
+                "itemScores": [
+                    {"item": f"item-{user}-{j}", "score": 1.0 - j * 0.1}
+                    for j in range(3)
+                ]
+            }
+
+        def batch_predict(self, model, iq):
+            return [(i, self.predict(model, q)) for i, q in iq]
+
+    from predictionio_tpu.server.prediction_server import DeployedEngine
+
+    deployed = DeployedEngine.__new__(DeployedEngine)
+    deployed._lock = threading.RLock()
+    deployed.instance = types.SimpleNamespace(
+        id="quality-e2e", engine_variant="champion"
+    )
+    deployed.storage = None
+    deployed.algorithms = [RankedEcho()]
+    deployed.models = [None]
+    deployed.serving = FirstServing()
+    deployed.engine = types.SimpleNamespace(params_from_json=lambda p: None)
+    deployed.extract_query = lambda payload: dict(payload)
+    return deployed
+
+
+def _post_json(url, payload, headers=None):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, dict(r.headers), json.loads(r.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+class TestEndToEndQualityLoop:
+    """The acceptance path: predictions through the aio front end, feedback
+    through real event-server ingest referencing them, a nonzero online
+    metric in /quality.json, then an injected covariate shift flips the
+    drift state to `drifting` with matching pio_drift_* gauges in /metrics —
+    while the stable phase alerted zero times."""
+
+    @pytest.fixture()
+    def stack(self, storage):
+        from predictionio_tpu.server.aio import AsyncAppServer
+        from predictionio_tpu.server.event_server import (
+            create_event_server_app,
+        )
+        from predictionio_tpu.server.httpd import AppServer
+        from predictionio_tpu.server.prediction_server import (
+            create_prediction_server_app,
+        )
+        from predictionio_tpu.tools import commands as cmd
+
+        registry = MetricsRegistry()
+        monitor = QualityMonitor(
+            registry=registry,
+            feedback_events=("rate",),
+            drift_window=60,
+            join_window_s=600.0,
+        )
+        pred_app = create_prediction_server_app(
+            _stub_deployed(),
+            use_microbatch=True,
+            registry=registry,
+            quality=monitor,
+        )
+        pred_srv = AsyncAppServer(pred_app, "127.0.0.1", 0).start_background()
+        event_app = create_event_server_app(
+            storage, registry=registry, quality=monitor
+        )
+        event_srv = AppServer(event_app, "127.0.0.1", 0).start_background()
+        d = cmd.app_new(storage, "e2equal")
+        yield types.SimpleNamespace(
+            pred=f"http://127.0.0.1:{pred_srv.port}",
+            events=f"http://127.0.0.1:{event_srv.port}",
+            key=d.keys[0].key,
+            monitor=monitor,
+            registry=registry,
+        )
+        pred_srv.shutdown()
+        event_srv.shutdown()
+
+    def test_loop_closes_and_drift_flips(self, stack):
+        rng = random.Random(21)
+
+        def serve(i, num):
+            status, headers, body = _post_json(
+                stack.pred + "/queries.json",
+                {"user": f"u{i % 5}", "num": num},
+            )
+            assert status == 200 and body["itemScores"]
+            return headers["X-Pio-Request-Id"], body
+
+        # stable phase: enough waves to seed the reference + several
+        # comparison windows, a few of them fed back through real ingest
+        rids = []
+        for i in range(240):
+            rid, body = serve(i, round(10 + rng.gauss(0, 1), 3))
+            rids.append((rid, body))
+        for i in range(0, 40, 4):
+            rid, body = rids[i]
+            status, _, out = _post_json(
+                stack.events + f"/events.json?accessKey={stack.key}",
+                {
+                    "event": "rate",
+                    "entityType": "user",
+                    "entityId": f"u{i % 5}",
+                    "targetEntityType": "item",
+                    "targetEntityId": body["itemScores"][0]["item"],
+                    "properties": {"rating": 4.0},
+                },
+                headers={"X-Pio-Request-Id": rid},
+            )
+            assert status == 201 and "eventId" in out
+
+        status, raw = _get(stack.pred + "/quality.json")
+        assert status == 200
+        snap = json.loads(raw)
+        champ = snap["variants"]["champion"]
+        assert champ["predictions"] >= 240
+        assert champ["joined"] >= 10
+        # a NONZERO per-variant online metric: the loop closed
+        assert champ["metrics"]["hit_rate"] == 1.0
+        assert champ["metrics"]["ctr"] > 0
+        # the stable soak alerted zero times
+        assert snap["drift"]["state"] == "ok"
+        assert all(
+            d["transitions"] == 0
+            for d in snap["drift"]["distributions"].values()
+        )
+        feature = snap["drift"]["distributions"]["feature:num"]
+        assert feature["windows"] >= 1  # comparisons actually ran
+
+        # covariate shift: the query distribution jumps 500 sigma
+        for i in range(200):
+            serve(i, round(510 + rng.gauss(0, 1), 3))
+        status, raw = _get(stack.pred + "/quality.json")
+        snap = json.loads(raw)
+        assert snap["drift"]["state"] == "drifting"
+        feature = snap["drift"]["distributions"]["feature:num"]
+        assert feature["state"] == "drifting"
+        assert feature["psi"] >= feature["thresholds"]["psi_drift"]
+
+        # the matching pio_drift_* gauges are in the Prometheus exposition
+        status, text = _get(stack.pred + "/metrics")
+        assert status == 200
+        assert 'pio_drift_state{distribution="feature:num"} 2' in text
+        assert 'pio_drift_psi{distribution="feature:num"}' in text
+        assert (
+            'pio_drift_transitions_total{distribution="feature:num",to="drifting"} 1'
+            in text
+        )
+        assert 'pio_quality_predictions_total{variant="champion"}' in text
+
+        # joins rode the request-id path, not the entity fallback
+        joined_fam = stack.registry.get("pio_quality_feedback_joined_total")
+        by_label = {lv: c.value for lv, c in joined_fam.series()}
+        assert by_label.get(("champion", "request_id"), 0) >= 10
+
+        # per-request records carry their wave metadata (microbatch meta)
+        with stack.monitor._lock:
+            rec = next(iter(stack.monitor._by_rid.values()))
+        assert rec["wave_size"] >= 1 and rec["wave_seq"] >= 1

@@ -209,6 +209,43 @@ class TestAuthAndOps:
         with pytest.raises(RemoteStorageError):
             c.json("GET", "/v1/ping")
 
+    def test_request_id_crosses_the_process_boundary(self, daemon, client):
+        """Satellite (cross-daemon correlation): a storage call made while
+        a request id is bound forwards X-Pio-Request-Id, and the daemon
+        ADOPTS it — its flight entry for the call carries the originating
+        id, so /debug/flight.json?request_id=<id> on the remote side finds
+        the work this request caused.  Before the fix the id died at the
+        process boundary (the daemon minted its own)."""
+        from predictionio_tpu.data.storage.remote_backend import RemoteApps
+        from predictionio_tpu.obs.logging import (
+            reset_request_context,
+            set_request_context,
+        )
+
+        rid = "corr-e2e-1234"
+        tokens = set_request_context(rid)
+        try:
+            RemoteApps(client).get_all()  # any storage round trip
+        finally:
+            reset_request_context(tokens)
+        snap = daemon.app.flight.snapshot(request_id=rid)
+        assert snap["slowest"], "daemon flight entry missing the client's id"
+        entry = snap["slowest"][0]
+        assert entry["request_id"] == rid
+        assert entry["path"] == "/v1/apps"
+        # and with NO bound context, no header is forwarded: the daemon
+        # mints a FRESH id for the second call, so exactly one flight entry
+        # ever carries ours
+        RemoteApps(client).get_all()
+        snap = daemon.app.flight.snapshot(request_id=rid)
+        assert len(snap["slowest"]) == 1
+        unfiltered = daemon.app.flight.snapshot()
+        assert len(unfiltered["slowest"]) == 2
+        other = [
+            e for e in unfiltered["slowest"] if e["request_id"] != rid
+        ]
+        assert len(other) == 1 and len(other[0]["request_id"]) == 16
+
     def test_multipart_model_checkpoint(self, daemon, client):
         m = RemoteModels(client)
         parts = {"leaf0": b"\x00" * 1000, "leaf1": b"\xff" * 10}
