@@ -8,6 +8,8 @@ families:
                   Python branches in @jit, recompile hazards (rules_jax)
   - PIO-CONC00x — blocking calls in async handlers, busy-wait polls,
                   unlocked mutation of lock-guarded state (rules_concurrency)
+  - PIO-RES00x  — network calls without timeouts, silent exception
+                  swallowing on serving hot paths (rules_resilience)
   - PIO-DASE00x — DataSource->Preparator->Algorithm->Serving signature /
                   params-dataclass contract checks (contract; import-based,
                   lazily loaded so plain lint runs never import jax)
@@ -36,6 +38,7 @@ from predictionio_tpu.analysis.rules import ALL_RULES, Rule  # noqa: F401
 # importing the rule modules registers them in ALL_RULES
 from predictionio_tpu.analysis import rules_concurrency  # noqa: E402,F401
 from predictionio_tpu.analysis import rules_jax  # noqa: E402,F401
+from predictionio_tpu.analysis import rules_resilience  # noqa: E402,F401
 
 __all__ = [
     "ALL_RULES",

@@ -1,0 +1,134 @@
+"""Resilience lints (rule family PIO-RES*).
+
+Motivating cases come from the failure modes the resilience layer
+(predictionio_tpu/resilience/) exists to kill: an HTTP call with no
+timeout turns one dead dependency into a permanently wedged thread, and a
+silent ``except Exception: pass`` on a serving path swallows
+``RemoteStorageError`` so a storage outage looks like healthy traffic —
+degradation must be *marked* (``resilience.degrade.mark_degraded``), never
+silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from predictionio_tpu.analysis.findings import Finding, Severity
+from predictionio_tpu.analysis.rules import (
+    ModuleInfo,
+    Rule,
+    enclosing_function,
+    resolve_call,
+    resolve_name,
+    rule,
+)
+from predictionio_tpu.analysis.rules_jax import _is_hot_function
+
+#: calls that open a network round trip, mapped to the 0-based POSITIONAL
+#: index of their ``timeout`` parameter (so a positional timeout is
+#: recognized, not just the keyword spelling)
+_TIMEOUT_CALLS = {
+    "urllib.request.urlopen": 2,  # urlopen(url, data, timeout)
+    "http.client.HTTPConnection": 2,  # (host, port, timeout)
+    "http.client.HTTPSConnection": 2,
+    "socket.create_connection": 1,  # (address, timeout)
+}
+
+
+@rule
+class NetworkCallWithoutTimeout(Rule):
+    """PIO-RES001: blocking network call without an explicit timeout."""
+
+    id = "PIO-RES001"
+    severity = Severity.MEDIUM
+    summary = (
+        "network call without an explicit timeout=; a dead peer wedges the "
+        "calling thread forever"
+    )
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = resolve_call(mod, node)
+            if callee not in _TIMEOUT_CALLS:
+                continue
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                continue
+            if any(kw.arg is None for kw in node.keywords):
+                continue  # **kwargs may carry it; don't guess
+            if len(node.args) > _TIMEOUT_CALLS[callee]:
+                continue  # timeout passed positionally
+            if any(isinstance(a, ast.Starred) for a in node.args):
+                continue  # *args may carry it; don't guess
+            yield self.finding(
+                mod,
+                node,
+                f"{callee}(...) has no explicit timeout=: the default is "
+                "block-forever, so one unreachable peer pins this thread "
+                "until process restart; pass timeout= (capped by the "
+                "request deadline where one is bound)",
+            )
+
+
+def _is_broad_handler(mod: ModuleInfo, handler: ast.ExceptHandler) -> bool:
+    """bare ``except:`` or ``except Exception/BaseException``."""
+    t = handler.type
+    if t is None:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if resolve_name(mod, n) in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _is_silent_body(body: list[ast.stmt]) -> bool:
+    """True when the handler does literally nothing (pass / ...)."""
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis
+        ):
+            continue
+        return False
+    return True
+
+
+@rule
+class SilentExceptionSwallowOnHotPath(Rule):
+    """PIO-RES002: ``except Exception: pass`` inside a serving hot-path
+    function."""
+
+    id = "PIO-RES002"
+    severity = Severity.HIGH
+    summary = (
+        "broad except with an empty body on a serving hot path; storage "
+        "outages (RemoteStorageError) vanish silently — mark degraded "
+        "instead"
+    )
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad_handler(mod, node):
+                continue
+            if not _is_silent_body(node.body):
+                continue
+            fn = enclosing_function(node)
+            if fn is None or not _is_hot_function(fn):
+                continue
+            yield self.finding(
+                mod,
+                node,
+                f"broad except with an empty body inside hot-path function "
+                f"{fn.name!r}: a RemoteStorageError here makes a storage "
+                "outage indistinguishable from health; at minimum call "
+                "resilience.degrade.mark_degraded(...) (and log) so the "
+                "fallback is visible in metrics and responses",
+            )

@@ -149,8 +149,16 @@ class StorageRuntime:
 
     def _remote_client(self, name: str, props: dict[str, str]):
         """Keep-alive HTTP client for a storage-daemon source (TYPE=remote,
-        the ES/HBase server-fleet role — server/storage_server.py)."""
+        the ES/HBase server-fleet role — server/storage_server.py).
+
+        Resilience knobs (all optional, docs/robustness.md):
+        ``RETRIES`` total attempts (default 2 = one retry),
+        ``RETRY_BACKOFF_S`` base decorrelated-jitter backoff,
+        ``BREAKER`` off|on, ``BREAKER_THRESHOLD`` consecutive transport
+        failures before the circuit opens, ``BREAKER_RESET_S`` open->half-
+        open delay."""
         from predictionio_tpu.data.storage.remote_backend import RemoteClient
+        from predictionio_tpu.resilience.retry import RetryPolicy
 
         with self._lock:
             key = f"__remote_{name}__"
@@ -161,6 +169,12 @@ class StorageRuntime:
                         f"remote source {name} needs PIO_STORAGE_SOURCES_"
                         f"{name}_URL (e.g. http://host:7072)"
                     )
+                breaker_off = props.get("BREAKER", "on").lower() in (
+                    "off",
+                    "false",
+                    "0",
+                    "no",
+                )
                 self._clients[key] = RemoteClient(
                     url,
                     auth_key=props.get("AUTHKEY"),
@@ -169,6 +183,15 @@ class StorageRuntime:
                     timeout=float(props.get("TIMEOUT", 30.0)),
                     verify=props.get("VERIFY", "true").lower()
                     not in ("false", "0", "no"),
+                    retry=RetryPolicy(
+                        max_attempts=max(int(props.get("RETRIES", 2)), 1),
+                        base_backoff_s=float(
+                            props.get("RETRY_BACKOFF_S", 0.05)
+                        ),
+                    ),
+                    breaker=None if breaker_off else "auto",
+                    breaker_threshold=int(props.get("BREAKER_THRESHOLD", 5)),
+                    breaker_reset_s=float(props.get("BREAKER_RESET_S", 5.0)),
                 )
             return self._clients[key]
 
@@ -306,6 +329,19 @@ class StorageRuntime:
                         self._event_client(), self.l_events()
                     )
             return self._clients["__pevents__"]
+
+    def breakers(self) -> list:
+        """Circuit breakers of every instantiated remote client in this
+        runtime — what /readyz folds in (scoped to THIS runtime's
+        endpoints, not every breaker in the process)."""
+        with self._lock:
+            clients = list(self._clients.values())
+        out = []
+        for c in clients:
+            br = getattr(c, "breaker", None)
+            if br is not None and br not in out:
+                out.append(br)
+        return out
 
     # -- ops -----------------------------------------------------------------
     def verify_all_data_objects(self) -> dict[str, bool]:

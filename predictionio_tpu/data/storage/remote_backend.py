@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import random
 import ssl
 import threading
 import time
@@ -42,10 +43,25 @@ from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import EventFilter, EventFrame
 from predictionio_tpu.data.storage.frame_codec import decode_frame, encode_frame
 from predictionio_tpu.obs.logging import REQUEST_ID_HEADER, get_request_id
+from predictionio_tpu.resilience import faults
+from predictionio_tpu.resilience.breaker import CircuitBreaker, CircuitOpen, get_breaker
+from predictionio_tpu.resilience.deadline import DeadlineExceeded, expired, remaining
+from predictionio_tpu.resilience.retry import RetryBudget, RetryPolicy
 
 
 class RemoteStorageError(Exception):
     """Transport- or server-side failure from the storage daemon."""
+
+
+class StorageUnavailable(RemoteStorageError):
+    """The daemon is known-unreachable right now (circuit breaker open or
+    every transport attempt failed).  Carries a ``retry_after_s`` hint so
+    callers (the event server's ingest surface) can answer
+    ``503 + Retry-After`` instead of a 500 traceback."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 # ---------------------------------------------------------------------------
@@ -151,6 +167,13 @@ class RemoteClient:
     (PIO_STORAGE_SOURCES_<name>_VERIFY=false) only for self-signed dev
     certs — with it off, an on-path attacker can read the access key and
     all stored data.
+
+    Resilience (docs/robustness.md): transport failures go through a
+    bounded :class:`RetryPolicy` (decorrelated-jitter backoff, retry
+    budget) behind a per-endpoint :class:`CircuitBreaker` — a dead daemon
+    costs ~0 ms per call once the breaker opens, instead of a connect
+    timeout per serving thread.  A request-context deadline caps every
+    socket timeout to the remaining budget.
     """
 
     def __init__(
@@ -159,6 +182,11 @@ class RemoteClient:
         auth_key: str | None = None,
         timeout: float = 30.0,
         verify: bool = True,
+        retry: RetryPolicy | None = None,
+        retry_budget: RetryBudget | None = None,
+        breaker: CircuitBreaker | None | str = "auto",
+        breaker_threshold: int = 5,
+        breaker_reset_s: float = 5.0,
     ):
         parts = urlsplit(url)
         if parts.scheme not in ("http", "https"):
@@ -169,6 +197,21 @@ class RemoteClient:
         self.auth_key = auth_key
         self.timeout = timeout
         self.verify = verify
+        #: one retry by default — the legacy behavior, now policy-shaped
+        self.retry = retry or RetryPolicy(max_attempts=2)
+        self.retry_budget = (
+            retry_budget if retry_budget is not None else RetryBudget()
+        )
+        if breaker == "auto":
+            # endpoint-keyed: every client pointed at this daemon shares
+            # one view of its health (first creation fixes the params)
+            breaker = get_breaker(
+                f"storage:{self.host}:{self.port}",
+                failure_threshold=breaker_threshold,
+                reset_timeout_s=breaker_reset_s,
+            )
+        self.breaker: CircuitBreaker | None = breaker
+        self._retry_rng = random.Random()
         self._local = threading.local()
 
     def _connection(self) -> http.client.HTTPConnection:
@@ -204,6 +247,27 @@ class RemoteClient:
     #: shrinks the window where the daemon's idle-close races our send
     _MAX_IDLE_S = 10.0
 
+    #: transport-level failures eligible for retry/breaker accounting
+    _NET_ERRORS = (
+        http.client.HTTPException,
+        ConnectionError,
+        BrokenPipeError,
+        TimeoutError,
+        OSError,
+    )
+
+    def _cap_timeout(self, conn: http.client.HTTPConnection) -> None:
+        """Bound this call's socket timeout by the remaining request
+        budget: a request with 200 ms left must not sit in a 30 s connect."""
+        t = self.timeout
+        rem = remaining()
+        if rem is not None:
+            t = max(min(t, rem), 0.001)
+        conn.timeout = t
+        sock = getattr(conn, "sock", None)
+        if sock is not None:
+            sock.settimeout(t)
+
     def request(
         self,
         method: str,
@@ -216,9 +280,10 @@ class RemoteClient:
         """One HTTP round trip.  ``idempotent`` declares whether a REPLAY of
         this exact request is safe (server upserts / overwrite semantics);
         None falls back to the method class (_IDEMPOTENT).  Replays happen
-        at most once, and only when the response was lost after a full
-        send; send-phase failures retry regardless (the daemon never saw a
-        complete framed request)."""
+        only when the response was lost after a full send; send-phase
+        failures retry regardless (the daemon never saw a complete framed
+        request).  Attempts are bounded by the retry policy + budget, gated
+        by the endpoint breaker, and capped by the request deadline."""
         q = dict(params or {})
         if q:
             path = f"{path}?{urlencode(q)}"
@@ -236,53 +301,122 @@ class RemoteClient:
             headers["Authorization"] = f"Bearer {self.auth_key}"
         if idempotent is None:
             idempotent = method in _IDEMPOTENT
-        _net_errors = (
-            http.client.HTTPException,
-            ConnectionError,
-            BrokenPipeError,
-            TimeoutError,
-            OSError,
-        )
+        label = f"{method} {path.split('?')[0]}"
+        # deadline admission: no budget left means no call at all
+        rem = remaining()
+        if rem is not None and rem <= 0:
+            raise DeadlineExceeded(
+                f"storage call {label} abandoned: request deadline exceeded"
+            )
+        # circuit breaker: a dead daemon costs ~0 ms once open
+        br = self.breaker
+        if br is not None:
+            try:
+                br.guard(f"storage call {label}")
+            except CircuitOpen as e:
+                raise StorageUnavailable(
+                    str(e), retry_after_s=e.retry_after_s
+                ) from e
+        try:
+            result = self._attempt(method, path, body, headers, idempotent, label)
+        except RemoteStorageError:
+            if br is not None:
+                br.record_failure()
+            raise
+        except BaseException:
+            # a deadline expiry (or anything non-transport) says nothing
+            # about the ENDPOINT's health: release a consumed half-open
+            # trial slot instead of leaking it, which would wedge the
+            # breaker half-open with no slots until process restart
+            if br is not None:
+                br.release_trial()
+            raise
+        if br is not None:
+            br.record_success()
+        if self.retry_budget is not None:
+            self.retry_budget.record_call()
+        return result
+
+    def _attempt(
+        self,
+        method: str,
+        path: str,
+        body: bytes | None,
+        headers: dict,
+        idempotent: bool,
+        label: str,
+    ) -> tuple[int, bytes]:
+        """The bounded attempt loop (breaker accounting happens above)."""
         if (
             getattr(self._local, "conn", None) is not None
             and time.monotonic() - getattr(self._local, "last_used", 0.0)
             > self._MAX_IDLE_S
         ):
             self._drop_connection()
-        for attempt in (0, 1):
+        policy = self.retry
+        attempt = 0
+        backoff = 0.0
+        # the time.sleep below is jittered retry BACKOFF between bounded
+        # attempts (the whole point is to wait), not a busy-wait poll —
+        # there is no event a producer could signal across processes
+        # pio: ignore[PIO-CONC002]
+        while True:
             conn = self._connection()
-            # Send phase.  A failure here (connect refused, pipe broken
-            # mid-send) means the daemon never saw a complete framed
-            # request, so ONE retry is safe for every method.
+            self._cap_timeout(conn)
+            sent = False
             try:
+                # Send phase.  A failure here (connect refused, pipe broken
+                # mid-send) means the daemon never saw a complete framed
+                # request, so a retry is safe for every method.  Response
+                # phase: the request was fully sent, the daemon may have
+                # processed it even though the response was lost, so only
+                # declared-idempotent requests may replay — callers that
+                # need replay safety make themselves idempotent (event
+                # inserts mint ids client-side so a replay upserts).
+                if faults.ACTIVE is not None:
+                    faults.ACTIVE.check("remote.send", label)
                 conn.request(method, path, body=body, headers=headers)
-            except _net_errors as e:
-                self._drop_connection()
-                if attempt:
-                    raise RemoteStorageError(
-                        f"storage daemon unreachable at "
-                        f"{self.scheme}://{self.host}:{self.port}: {e}"
-                    ) from e
-                continue
-            # Response phase.  The request was fully sent; the daemon may
-            # have processed it even though the response was lost, so only
-            # declared-idempotent requests may replay.  Non-idempotent
-            # requests fail loudly — callers that need replay safety make
-            # themselves idempotent (event inserts mint ids client-side so
-            # a replay upserts).
-            try:
+                sent = True
+                if faults.ACTIVE is not None:
+                    faults.ACTIVE.check("remote.response", label)
                 resp = conn.getresponse()
                 status, data = resp.status, resp.read()
                 self._local.last_used = time.monotonic()
                 return status, data
-            except _net_errors as e:
+            except self._NET_ERRORS as e:
                 self._drop_connection()
-                if attempt or not idempotent:
-                    raise RemoteStorageError(
-                        f"{method} {path.split('?')[0]} to storage daemon "
-                        f"failed after send: {e}"
+                if expired():
+                    # the socket timeout was the deadline, not the daemon:
+                    # report a budget failure, not an endpoint failure
+                    raise DeadlineExceeded(
+                        f"storage call {label} ran out of request budget: {e}"
                     ) from e
-        raise AssertionError("unreachable")
+                attempt += 1
+                retryable = (not sent) or idempotent
+                if (
+                    not retryable
+                    or attempt >= policy.max_attempts
+                    or not self._spend_retry()
+                ):
+                    if sent:
+                        raise RemoteStorageError(
+                            f"{label} to storage daemon failed after send: {e}"
+                        ) from e
+                    raise StorageUnavailable(
+                        f"storage daemon unreachable at "
+                        f"{self.scheme}://{self.host}:{self.port}: {e}"
+                    ) from e
+                backoff = policy.backoff_s(backoff, self._retry_rng)
+                rem = remaining()
+                if rem is not None:
+                    # never sleep past the deadline; a shaved backoff still
+                    # gets the attempt in under budget
+                    backoff = min(backoff, max(rem - 0.001, 0.0))
+                if backoff > 0:
+                    time.sleep(backoff)
+
+    def _spend_retry(self) -> bool:
+        return self.retry_budget is None or self.retry_budget.try_spend()
 
     def json(
         self,

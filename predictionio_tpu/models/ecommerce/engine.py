@@ -37,6 +37,7 @@ from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.models.filters import CategoryIndex, exclude_mask
 from predictionio_tpu.ops.als import ALSParams, train_als
 from predictionio_tpu.ops.similarity import cosine_topk, dot_topk
+from predictionio_tpu.resilience.degrade import mark_degraded
 
 
 @dataclass(frozen=True)
@@ -281,7 +282,14 @@ class ECommAlgorithm(Algorithm):
     # -- business rules ------------------------------------------------------
     def _gen_black_list(self, ctx: EngineContext, query: Query) -> set[str]:
         """Seen events + unavailableItems constraint + query blackList
-        (ECommAlgorithm.genBlackList)."""
+        (ECommAlgorithm.genBlackList).
+
+        The live event-store reads here are the hot path's dependency on
+        the storage fleet: when the store is unreachable (or the circuit
+        breaker is open, which fails in ~0 ms), the query still answers
+        from the model alone — marked degraded, never errored (the
+        reference template's timeout-to-empty-list semantics, made
+        visible)."""
         seen: set[str] = set()
         store = ctx.l_event_store
         if self.params.unseen_only:
@@ -298,6 +306,7 @@ class ECommAlgorithm(Algorithm):
                     if e.target_entity_id is not None
                 }
             except Exception:
+                mark_degraded("seen_filter")
                 seen = set()  # timeout semantics: empty seen list
         unavailable: set[str] = set()
         try:
@@ -312,11 +321,14 @@ class ECommAlgorithm(Algorithm):
             for e in latest:
                 unavailable = set(e.properties.get_or_else("items", []))
         except Exception:
+            mark_degraded("unavailable_items")
             unavailable = set()
         return seen | unavailable | set(query.black_list or ())
 
     def _recent_items(self, ctx: EngineContext, query: Query) -> list[str]:
-        """Latest 10 similar-events targets for the user (getRecentItems)."""
+        """Latest 10 similar-events targets for the user (getRecentItems).
+        Store unreachable -> no recent signal: the cold-user path falls
+        through to popularity, marked degraded."""
         try:
             events = ctx.l_event_store.find_by_entity(
                 self.params.app_name,
@@ -329,6 +341,7 @@ class ECommAlgorithm(Algorithm):
             )
             return [e.target_entity_id for e in events if e.target_entity_id]
         except Exception:
+            mark_degraded("recent_items")
             return []
 
     def _exclude_mask(

@@ -1,0 +1,69 @@
+"""Resilience layer: deadlines, load shedding, circuit breakers, degraded
+serving, and deterministic fault injection.
+
+PRs 1-4 made failures *visible* (metrics, flight recorder, SLO burn rates,
+drift); this package makes the system *fail well*.  The reference leaned on
+Spark's task retry/speculation for fault tolerance (SURVEY.md §4) — the
+TPU-native serving path needs its own primitives, the ones production
+serving systems treat as first-class (TensorFlow's explicit fault-tolerance
+design, arxiv 1605.08695; DrJAX's bounded composable execution, arxiv
+2403.07128):
+
+- :mod:`deadline` — per-request time budgets bound to the request
+  contextvars (``X-Pio-Deadline``), enforced at admission, before each
+  MicroBatcher wave, and capping every outbound storage call;
+- :mod:`admission` — bounded in-flight request cap so overload sheds with
+  ``503 + Retry-After`` instead of collapsing;
+- :mod:`retry` — bounded retry policy with decorrelated-jitter backoff and
+  a retry budget (no retry storms);
+- :mod:`breaker` — closed→open→half-open circuit breakers per daemon
+  endpoint, exported as ``pio_breaker_state`` gauges;
+- :mod:`degrade` — mark responses/metrics degraded when an engine falls
+  back to model-only serving instead of erroring;
+- :mod:`faults` — a seeded, plan-driven fault injector at the RemoteClient
+  transport seam and the MicroBatcher ``batch_fn`` seam (zero overhead when
+  disabled) powering the deterministic chaos suite.
+
+See docs/robustness.md for semantics and the fault-plan cookbook.
+"""
+
+from predictionio_tpu.resilience.admission import AdmissionController  # noqa: F401
+from predictionio_tpu.resilience.breaker import (  # noqa: F401
+    BREAKER_STATES,
+    CircuitBreaker,
+    CircuitOpen,
+    breaker_states,
+    get_breaker,
+    reset_breakers,
+)
+from predictionio_tpu.resilience.deadline import (  # noqa: F401
+    DEADLINE_HEADER,
+    DeadlineExceeded,
+    deadline_scope,
+    get_deadline,
+    remaining,
+)
+from predictionio_tpu.resilience.degrade import (  # noqa: F401
+    current_degraded,
+    degraded_scope,
+    mark_degraded,
+)
+from predictionio_tpu.resilience.faults import (  # noqa: F401
+    FaultInjected,
+    FaultInjector,
+    FaultRule,
+)
+from predictionio_tpu.resilience.retry import (  # noqa: F401
+    RetryBudget,
+    RetryPolicy,
+)
+
+
+class LoadShed(Exception):
+    """Request rejected by admission control (bounded queue / in-flight
+    cap).  Maps to ``503`` with a ``Retry-After`` header so well-behaved
+    clients back off instead of hammering a saturated server."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s

@@ -39,12 +39,17 @@ from predictionio_tpu.obs.logging import (
 )
 from predictionio_tpu.obs.metrics import REGISTRY
 from predictionio_tpu.obs.tracing import trace
+from predictionio_tpu.resilience.deadline import deadline_scope
 from predictionio_tpu.server.httpd import (
     HTTPApp,
     Request,
     Response,
+    admission_expired_response,
+    admit_request,
     error_response,
+    exception_response,
     header_get,
+    request_budget,
     unquote_groups,
 )
 
@@ -80,9 +85,30 @@ async def _handle_app_request(app: HTTPApp, req: Request) -> Response:
     if is_observability_path(req.path):
         resp = await _route_app_request(app, req)
     else:
-        tokens = set_request_context(rid)
-        ann_token = begin_annotations()
-        try:
+        resp = await _observe_app_request(app, req, rid, t0)
+    resp.headers.setdefault(REQUEST_ID_HEADER, rid)
+    method = req.method if req.method in _KNOWN_METHODS else "OTHER"
+    _m_http.labels(app.name, method, str(resp.status)).observe(
+        time.perf_counter() - t0
+    )
+    return resp
+
+
+async def _observe_app_request(
+    app: HTTPApp, req: Request, rid: str, t0: float
+) -> Response:
+    """The accounted (non-observability) request path: admission control,
+    deadline binding, root span, SLO + flight accounting."""
+    adm, shed = admit_request(app)
+    if shed is not None:
+        return shed
+    budget = request_budget(app, req)
+    tokens = set_request_context(rid)
+    ann_token = begin_annotations()
+    try:
+        if budget is not None and budget <= 0:
+            return admission_expired_response(app)
+        with deadline_scope(budget_s=budget):
             with trace(f"http.{app.name}", record=False) as span:
                 resp = await _route_app_request(app, req)
                 span.tags = {
@@ -96,15 +122,12 @@ async def _handle_app_request(app: HTTPApp, req: Request) -> Response:
                 )
             except Exception:  # telemetry must never fail the request
                 pass
-        finally:
-            end_annotations(ann_token)
-            reset_request_context(tokens)
-    resp.headers.setdefault(REQUEST_ID_HEADER, rid)
-    method = req.method if req.method in _KNOWN_METHODS else "OTHER"
-    _m_http.labels(app.name, method, str(resp.status)).observe(
-        time.perf_counter() - t0
-    )
-    return resp
+        return resp
+    finally:
+        if adm is not None:
+            adm.release()
+        end_annotations(ann_token)
+        reset_request_context(tokens)
 
 
 async def _route_app_request(app: HTTPApp, req: Request) -> Response:
@@ -126,7 +149,7 @@ async def _route_app_request(app: HTTPApp, req: Request) -> Response:
         ctx = contextvars.copy_context()
         return await loop.run_in_executor(None, ctx.run, fn, req)
     except Exception as e:
-        return error_response(500, f"{type(e).__name__}: {e}")
+        return exception_response(e)
 
 
 async def _read_request(reader: asyncio.StreamReader) -> Request | None:

@@ -29,6 +29,7 @@ from typing import Any
 from predictionio_tpu.data.event import Event, EventValidationError
 from predictionio_tpu.data.storage.base import EventFilter
 from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
+from predictionio_tpu.data.storage.remote_backend import RemoteStorageError
 from predictionio_tpu.data.webhooks import (
     ConnectorException,
     form_connectors,
@@ -64,6 +65,22 @@ class AuthError(Exception):
     def __init__(self, status: int, message: str):
         super().__init__(message)
         self.status = status
+
+
+#: event-store failures that mean "temporarily unavailable, retry later" —
+#: an unreachable storage daemon (or its open circuit breaker) must answer
+#: ingest with 503 + Retry-After, not a 500 traceback, so well-behaved SDK
+#: clients back off and retry instead of dropping events
+_STORE_UNAVAILABLE = (RemoteStorageError, ConnectionError, TimeoutError)
+
+
+def _unavailable_response(e: Exception) -> "Response":
+    from predictionio_tpu.server.httpd import shed_response
+
+    return shed_response(
+        f"event store unavailable: {e}",
+        getattr(e, "retry_after_s", 1.0),
+    )
 
 
 def _authenticate(storage: StorageRuntime, req: Request) -> AuthData:
@@ -163,6 +180,9 @@ def create_event_server_app(
                 auth = _authenticate(storage, req)
             except AuthError as e:
                 return error_response(e.status, str(e))
+            except _STORE_UNAVAILABLE as e:
+                # key lookup needs the metadata store: down -> retryable
+                return _unavailable_response(e)
             return handler(req, auth)
 
         return wrapped
@@ -219,7 +239,10 @@ def create_event_server_app(
             plugins.process_input(auth.app_id, auth.channel_id, event)
         except Exception as e:  # an input blocker rejected the event
             return error_response(403, f"rejected by plugin: {e}")
-        event_id = levents.insert(event, auth.app_id, auth.channel_id)
+        try:
+            event_id = levents.insert(event, auth.app_id, auth.channel_id)
+        except _STORE_UNAVAILABLE as e:
+            return _unavailable_response(e)
         bookkeep(auth, 201, event)
         return json_response(201, {"eventId": event_id})
 
@@ -313,6 +336,11 @@ def create_event_server_app(
                 continue
             try:
                 event_id = levents.insert(event, auth.app_id, auth.channel_id)
+            except _STORE_UNAVAILABLE as e:
+                # per-item 503: the batch contract stays "one status per
+                # event", and the store being down is retryable, not a 500
+                results.append({"status": 503, "message": str(e)})
+                continue
             except Exception as e:
                 results.append({"status": 500, "message": str(e)})
                 continue
@@ -357,7 +385,10 @@ def create_event_server_app(
             plugins.process_input(auth.app_id, auth.channel_id, event)
         except Exception as e:
             return error_response(403, f"rejected by plugin: {e}")
-        event_id = levents.insert(event, auth.app_id, auth.channel_id)
+        try:
+            event_id = levents.insert(event, auth.app_id, auth.channel_id)
+        except _STORE_UNAVAILABLE as e:
+            return _unavailable_response(e)
         bookkeep(auth, 201, event)
         return json_response(201, {"eventId": event_id})
 

@@ -28,6 +28,14 @@ from predictionio_tpu.obs.logging import (
     reset_request_context,
     set_request_context,
 )
+from predictionio_tpu.resilience import LoadShed
+from predictionio_tpu.resilience.breaker import CircuitOpen
+from predictionio_tpu.resilience.deadline import (
+    DEADLINE_HEADER,
+    DeadlineExceeded,
+    deadline_scope,
+    parse_budget,
+)
 
 
 @dataclass
@@ -98,6 +106,72 @@ def json_response(status: int, body: Any) -> Response:
 
 def error_response(status: int, message: str) -> Response:
     return Response(status=status, body={"message": message})
+
+
+def shed_response(message: str, retry_after_s: float = 1.0) -> Response:
+    """503 with a ``Retry-After`` hint — the load-shedding answer.  A shed
+    is cheap to produce and honest to the client: back off and retry,
+    rather than queue behind a saturated server until you time out."""
+    import math
+
+    resp = error_response(503, message)
+    resp.headers["Retry-After"] = str(max(int(math.ceil(retry_after_s)), 1))
+    return resp
+
+
+def exception_response(e: Exception) -> Response:
+    """Map a handler exception to its HTTP shape: deadline errors are 504,
+    shed/breaker rejections are 503 + Retry-After, anything else is the
+    legacy 500.  Shared by both front ends and ``HTTPApp.handle`` so a
+    sync handler raising ``DeadlineExceeded`` answers the same as an async
+    one."""
+    if isinstance(e, DeadlineExceeded):
+        return error_response(504, f"deadline exceeded: {e}")
+    if isinstance(e, (LoadShed, CircuitOpen)):
+        return shed_response(str(e), getattr(e, "retry_after_s", 1.0))
+    return error_response(500, f"{type(e).__name__}: {e}")
+
+
+def request_budget(app: "HTTPApp", req: Request) -> float | None:
+    """The request's time budget in seconds: the ``X-Pio-Deadline`` header
+    when present (malformed values are ignored, not 500s), else the
+    server's ``default_deadline_s`` (None = no deadline)."""
+    budget = parse_budget(header_get(req.headers, DEADLINE_HEADER))
+    if budget is None:
+        budget = getattr(app, "default_deadline_s", None)
+    return budget
+
+
+def _record_slo_failure(app: "HTTPApp") -> None:
+    """Admission rejections (sheds, expired budgets) are user-visible
+    failures: they must burn SLO error budget so overload pages someone."""
+    slo = getattr(app, "slo", None)
+    if slo is not None:
+        slo.record(False, 0.0)
+
+
+def admit_request(app: "HTTPApp"):
+    """In-flight admission gate shared by both HTTP front ends.
+
+    Returns ``(controller, None)`` when admitted — ``controller`` is what
+    the caller must ``release()`` in its finally (None when no cap is
+    configured) — or ``(None, 503-shed-response)`` when rejected: past the
+    cap, shedding NOW is cheaper for everyone than queueing into a
+    timeout."""
+    adm = getattr(app, "admission", None)
+    if adm is None or adm.try_acquire():
+        return adm, None
+    _record_slo_failure(app)
+    return None, shed_response(
+        "server over capacity; retry later", adm.retry_after_s
+    )
+
+
+def admission_expired_response(app: "HTTPApp") -> Response:
+    """504 for a request whose budget was already gone at admission —
+    answering now beats doing work nobody will read."""
+    _record_slo_failure(app)
+    return error_response(504, "deadline expired at admission")
 
 
 def header_get(headers: Mapping[str, str] | None, name: str) -> str:
@@ -205,7 +279,7 @@ class HTTPApp:
         try:
             return fn(req)
         except Exception as e:  # the exceptionHandler analog
-            return error_response(500, f"{type(e).__name__}: {e}")
+            return exception_response(e)
 
 
 def observe_request(
@@ -229,26 +303,38 @@ def observe_request(
         resp = call(req)
         resp.headers.setdefault(REQUEST_ID_HEADER, rid)
         return resp
+    adm, shed = admit_request(app)
+    if shed is not None:
+        shed.headers.setdefault(REQUEST_ID_HEADER, rid)
+        return shed
+    budget = request_budget(app, req)
     tokens = set_request_context(rid)
     ann_token = begin_annotations()
     t0 = time.perf_counter()
     try:
-        with trace(f"http.{app.name}", record=False) as span:
-            resp = call(req)
-            span.tags = {
-                "method": req.method,
-                "path": req.path,
-                "status": resp.status,
-            }
+        if budget is not None and budget <= 0:
+            resp = admission_expired_response(app)
+        else:
+            with deadline_scope(budget_s=budget):
+                with trace(f"http.{app.name}", record=False) as span:
+                    resp = call(req)
+                    span.tags = {
+                        "method": req.method,
+                        "path": req.path,
+                        "status": resp.status,
+                    }
+                resp.headers.setdefault(REQUEST_ID_HEADER, rid)
+                try:
+                    record_request_outcome(
+                        app, req, resp, time.perf_counter() - t0, span
+                    )
+                except Exception:  # telemetry must never fail the request
+                    pass
         resp.headers.setdefault(REQUEST_ID_HEADER, rid)
-        try:
-            record_request_outcome(
-                app, req, resp, time.perf_counter() - t0, span
-            )
-        except Exception:  # telemetry must never fail the request
-            pass
         return resp
     finally:
+        if adm is not None:
+            adm.release()
         end_annotations(ann_token)
         reset_request_context(tokens)
 

@@ -15,6 +15,20 @@ load waves grow to the cap (maximum throughput).  Dispatches run on ONE
 long-lived DAEMON worker thread, which also serializes device access —
 daemon so a wedged ``batch_fn`` (a stalled device dispatch) can never block
 interpreter exit, long-lived so the hot path never pays thread creation.
+
+Resilience semantics (docs/robustness.md):
+
+- the queue is *bounded* (``max_queue``): past the bound, ``submit`` sheds
+  with :class:`~predictionio_tpu.resilience.LoadShed` instead of letting
+  the backlog grow without limit under overload;
+- each item captures the submitter's deadline; items whose deadline passed
+  while queued resolve with ``DeadlineExceeded`` *before* the wave
+  dispatches — no device time for answers nobody is waiting for — and the
+  wave's earliest deadline is re-bound around ``batch_fn`` so outbound
+  storage calls inside it stay under budget;
+- a ``batch_fn`` exception on a multi-item wave triggers ONE bounded
+  solo-retry pass, so a poison query fails alone instead of failing its
+  wave-mates.
 """
 
 from __future__ import annotations
@@ -32,6 +46,14 @@ from predictionio_tpu.obs.metrics import (
     MetricsRegistry,
     SIZE_BUCKETS,
 )
+from predictionio_tpu.resilience import LoadShed, faults
+from predictionio_tpu.resilience.admission import shed_counter
+from predictionio_tpu.resilience.deadline import (
+    DeadlineExceeded,
+    deadline_scope,
+    get_deadline,
+)
+from predictionio_tpu.resilience.deadline import _now as _deadline_now
 
 log = logging.getLogger("predictionio_tpu.microbatch")
 
@@ -57,15 +79,23 @@ class MicroBatcher:
         max_batch: int = 64,
         drain_timeout_s: float = 5.0,
         registry: MetricsRegistry | None = None,
+        max_queue: int | None = 1024,
+        solo_retry: bool = True,
     ):
         self.batch_fn = batch_fn
         self.max_batch = max_batch
         #: how long close() waits for the in-flight wave before abandoning
         #: the daemon worker (was a hard-coded 5.0 s deadline)
         self.drain_timeout_s = drain_timeout_s
-        #: (item, future, enqueue_time, request_id, meta) per pending query
+        #: queued (not in-flight) items past which submit() sheds with
+        #: LoadShed -> 503 + Retry-After; None = unbounded (legacy)
+        self.max_queue = max_queue
+        #: retry a failed multi-item wave one item at a time so a poison
+        #: query fails alone (one bounded pass, never recursive)
+        self.solo_retry = solo_retry
+        #: (item, future, enqueue_time, request_id, meta, deadline)
         self._pending: deque[
-            tuple[Any, asyncio.Future, float, str | None, dict | None]
+            tuple[Any, asyncio.Future, float, str | None, dict | None, float | None]
         ] = deque()
         self._cond = threading.Condition()
         self._worker: threading.Thread | None = None
@@ -77,6 +107,10 @@ class MicroBatcher:
         #: meta so downstream consumers (flight recorder, prediction log)
         #: can tell which dispatch wave served a request
         self._wave_seq = 0
+        #: label for the batch_fn fault-injection seam
+        self._fault_label = getattr(
+            batch_fn, "__qualname__", getattr(batch_fn, "__name__", "batch_fn")
+        )
         reg = registry or REGISTRY
         self._m_queue_depth = reg.gauge(
             "pio_microbatch_queue_depth",
@@ -99,6 +133,15 @@ class MicroBatcher:
             "pio_microbatch_drain_timeout_total",
             "close() deadlines expired with a wave still in flight",
         )
+        self._m_shed = shed_counter(reg).labels("queue")
+        self._m_expired = reg.counter(
+            "pio_microbatch_deadline_expired_total",
+            "Queued queries resolved with a deadline error before dispatch",
+        )
+        self._m_solo_retry = reg.counter(
+            "pio_microbatch_solo_retry_total",
+            "Failed waves retried item-by-item to isolate a poison query",
+        )
 
     def wave_histogram(self) -> dict[int, int]:
         """Consistent snapshot of the wave-size histogram.
@@ -120,14 +163,34 @@ class MicroBatcher:
         """Queue ``item`` for the next wave.  ``meta``, when given, is
         filled by the worker with this item's queue_wait_s / device_s /
         wave_size / wave_request_ids before the result future resolves —
-        the per-request latency decomposition for the flight recorder."""
+        the per-request latency decomposition for the flight recorder.
+
+        Sheds with :class:`LoadShed` when ``max_queue`` items are already
+        queued, and captures the caller's deadline (if one is bound) so the
+        worker can expire it instead of dispatching it late."""
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         with self._cond:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
+            if (
+                self.max_queue is not None
+                and len(self._pending) >= self.max_queue
+            ):
+                self._m_shed.inc()
+                raise LoadShed(
+                    f"microbatch queue full ({self.max_queue} queued)",
+                    retry_after_s=1.0,
+                )
             self._pending.append(
-                (item, fut, time.perf_counter(), get_request_id(), meta)
+                (
+                    item,
+                    fut,
+                    time.perf_counter(),
+                    get_request_id(),
+                    meta,
+                    get_deadline(),
+                )
             )
             self._m_queue_depth.set(len(self._pending))
             if self._worker is None:
@@ -143,16 +206,27 @@ class MicroBatcher:
         BOUNDEDLY for the in-flight wave — queued submit() futures must not
         hang until client timeout, and a wedged batch_fn (e.g. a stalled
         device dispatch) must not hang shutdown: past the deadline the
-        daemon worker is simply abandoned."""
+        daemon worker is simply abandoned.  Items whose deadline already
+        passed resolve with DeadlineExceeded (not leaked, not mislabeled as
+        a shutdown artifact); the rest get the shutdown error."""
         with self._cond:
             self._closed = True
             dropped = list(self._pending)
             self._pending.clear()
             self._cond.notify_all()
         err = RuntimeError("MicroBatcher closed during shutdown")
-        for _, fut, _t, _rid, _meta in dropped:
+        now = _deadline_now()
+        for _, fut, _t, _rid, _meta, dl in dropped:
+            item_err: BaseException = err
+            if dl is not None and dl <= now:
+                self._m_expired.inc()
+                item_err = DeadlineExceeded(
+                    "query deadline expired while queued (server shutdown)"
+                )
             try:
-                fut.get_loop().call_soon_threadsafe(_fail_if_pending, fut, err)
+                fut.get_loop().call_soon_threadsafe(
+                    _fail_if_pending, fut, item_err
+                )
             except RuntimeError:
                 # the futures' loop is already closed (server tore the
                 # loop down first) — nothing can await them anymore
@@ -183,59 +257,152 @@ class MicroBatcher:
                 self._wave_seq += 1
                 wave_seq = self._wave_seq
                 self._m_queue_depth.set(len(self._pending))
-            t_dispatch = time.perf_counter()
-            items = [it for it, _, _, _, _ in wave]
-            futures = [f for _, f, _, _, _ in wave]
-            rids = [r for _, _, _, r, _ in wave if r]
-            self._m_batch_size.observe(len(items))
-            for _, _, t_enq, _, _ in wave:
-                self._m_queue_wait.observe(t_dispatch - t_enq)
-            # the correlation line: a wave's log entry names the requests it
-            # coalesced, so one slow query's request_id finds its wave
-            # mates.  ring_debug reaches /logs.json even when the embedding
-            # app never configured logging.
-            ring_debug(
-                log,
-                "microbatch wave dispatched",
-                wave_size=len(items),
-                wave_seq=wave_seq,
-                request_ids=rids,
-            )
-            # all futures in a wave come from submit() calls on the same
-            # server loop; resolve with ONE loop wakeup
-            loop = futures[0].get_loop()
             try:
-                results = self.batch_fn(items)
-                device_s = time.perf_counter() - t_dispatch
-                self._m_device_time.observe(device_s)
-                if len(results) != len(items):
-                    raise RuntimeError(
-                        f"batch_fn returned {len(results)} results "
-                        f"for {len(items)} items"
-                    )
-                # fill per-item timing meta BEFORE resolving the futures:
-                # call_soon_threadsafe orders these writes before the
-                # submitter's read on the loop thread
-                for _, _, t_enq, _, meta in wave:
-                    if meta is not None:
-                        meta["queue_wait_s"] = round(t_dispatch - t_enq, 6)
-                        meta["device_s"] = round(device_s, 6)
-                        meta["wave_size"] = len(items)
-                        meta["wave_seq"] = wave_seq
-                        meta["wave_request_ids"] = rids
-                # under the cond: the status page reads wave_sizes from
-                # other threads, and dict writes must not race its snapshot
-                with self._cond:
-                    self.wave_sizes[len(items)] = (
-                        self.wave_sizes.get(len(items), 0) + 1
-                    )
-                self._post(loop, futures, results, None)
-            except Exception as e:
-                self._post(loop, futures, None, e)
+                self._dispatch_wave(wave, wave_seq)
             finally:
                 with self._cond:
                     self._in_wave = False
                     self._cond.notify_all()  # wake close() waiters
+
+    def _call_batch_fn(self, items: list[Any]) -> Sequence[Any]:
+        """The batch_fn fault-injection seam (docs/robustness.md); one
+        attribute check when no plan is installed."""
+        if faults.ACTIVE is not None:
+            faults.ACTIVE.check("batch_fn", self._fault_label)
+        results = self.batch_fn(items)
+        if len(results) != len(items):
+            raise RuntimeError(
+                f"batch_fn returned {len(results)} results "
+                f"for {len(items)} items"
+            )
+        return results
+
+    def _dispatch_wave(self, wave: list[tuple], wave_seq: int) -> None:
+        t_dispatch = time.perf_counter()
+        # deadline re-check at dispatch: items that expired while queued
+        # resolve with DeadlineExceeded instead of spending device time on
+        # an answer nobody is waiting for
+        now = _deadline_now()
+        live: list[tuple] = []
+        for entry in wave:
+            _, fut, t_enq, _, meta, dl = entry
+            if dl is not None and dl <= now:
+                self._m_expired.inc()
+                if meta is not None:
+                    meta["queue_wait_s"] = round(t_dispatch - t_enq, 6)
+                    meta["deadline_expired"] = True
+                _post_one(
+                    fut,
+                    error=DeadlineExceeded(
+                        "query deadline expired while queued behind the "
+                        "in-flight wave"
+                    ),
+                )
+            else:
+                live.append(entry)
+        if not live:
+            return
+        items = [it for it, _, _, _, _, _ in live]
+        futures = [f for _, f, _, _, _, _ in live]
+        rids = [r for _, _, _, r, _, _ in live if r]
+        deadlines = [dl for _, _, _, _, _, dl in live if dl is not None]
+        wave_deadline = min(deadlines) if deadlines else None
+        self._m_batch_size.observe(len(items))
+        for _, _, t_enq, _, _, _ in live:
+            self._m_queue_wait.observe(t_dispatch - t_enq)
+        # the correlation line: a wave's log entry names the requests it
+        # coalesced, so one slow query's request_id finds its wave
+        # mates.  ring_debug reaches /logs.json even when the embedding
+        # app never configured logging.
+        ring_debug(
+            log,
+            "microbatch wave dispatched",
+            wave_size=len(items),
+            wave_seq=wave_seq,
+            request_ids=rids,
+        )
+        # all futures in a wave come from submit() calls on the same
+        # server loop; resolve with ONE loop wakeup
+        loop = futures[0].get_loop()
+        try:
+            # re-bind the wave's tightest deadline around batch_fn so
+            # outbound storage calls inside it stay under budget
+            with deadline_scope(absolute=wave_deadline):
+                results = self._call_batch_fn(items)
+            device_s = time.perf_counter() - t_dispatch
+            self._m_device_time.observe(device_s)
+            # fill per-item timing meta BEFORE resolving the futures:
+            # call_soon_threadsafe orders these writes before the
+            # submitter's read on the loop thread
+            for _, _, t_enq, _, meta, _ in live:
+                if meta is not None:
+                    meta["queue_wait_s"] = round(t_dispatch - t_enq, 6)
+                    meta["device_s"] = round(device_s, 6)
+                    meta["wave_size"] = len(items)
+                    meta["wave_seq"] = wave_seq
+                    meta["wave_request_ids"] = rids
+            # under the cond: the status page reads wave_sizes from
+            # other threads, and dict writes must not race its snapshot
+            with self._cond:
+                self.wave_sizes[len(items)] = (
+                    self.wave_sizes.get(len(items), 0) + 1
+                )
+            self._post(loop, futures, results, None)
+        except Exception as e:
+            if len(live) == 1 or not self.solo_retry:
+                self._post(loop, futures, None, e)
+            else:
+                self._solo_retry_pass(live, e, wave_seq)
+
+    def _solo_retry_pass(
+        self, live: list[tuple], wave_error: BaseException, wave_seq: int
+    ) -> None:
+        """ONE bounded re-dispatch of a failed wave, item by item, so a
+        poison query fails alone instead of failing its wave-mates.  Runs
+        inside the same _in_wave window (close() waits for it, boundedly);
+        a close() arriving mid-pass fails the remaining items immediately
+        with the wave error instead of holding shutdown hostage."""
+        self._m_solo_retry.inc()
+        log.warning(
+            "wave %d (%d items) failed (%s: %s); solo-retrying to isolate",
+            wave_seq,
+            len(live),
+            type(wave_error).__name__,
+            wave_error,
+        )
+        now = _deadline_now()
+        for item, fut, t_enq, _rid, meta, dl in live:
+            if self._closed:
+                _post_one(fut, error=wave_error)
+                continue
+            if dl is not None and dl <= now:
+                self._m_expired.inc()
+                if meta is not None:
+                    meta["deadline_expired"] = True
+                _post_one(
+                    fut,
+                    error=DeadlineExceeded(
+                        "query deadline expired during wave retry"
+                    ),
+                )
+                continue
+            t0 = time.perf_counter()
+            try:
+                with deadline_scope(absolute=dl):
+                    result = self._call_batch_fn([item])[0]
+            except Exception as e:
+                _post_one(fut, error=e)
+                continue
+            if meta is not None:
+                meta["queue_wait_s"] = round(t0 - t_enq, 6)
+                meta["device_s"] = round(time.perf_counter() - t0, 6)
+                meta["wave_size"] = 1
+                meta["wave_seq"] = wave_seq
+                meta["solo_retry"] = True
+            with self._cond:
+                self.wave_sizes[1] = self.wave_sizes.get(1, 0) + 1
+            _post_one(fut, result=result)
+            now = _deadline_now()
 
     @staticmethod
     def _post(loop, futures, results, error) -> None:
@@ -243,6 +410,23 @@ class MicroBatcher:
             loop.call_soon_threadsafe(_resolve_wave, futures, results, error)
         except RuntimeError:
             pass  # loop already closed during shutdown
+
+
+def _post_one(fut: asyncio.Future, result=None, error=None) -> None:
+    """Resolve one future from the worker thread (loop-safe)."""
+    try:
+        fut.get_loop().call_soon_threadsafe(_resolve_one, fut, result, error)
+    except RuntimeError:
+        pass  # loop already closed during shutdown
+
+
+def _resolve_one(fut: asyncio.Future, result, error) -> None:
+    if fut.done():
+        return
+    if error is not None:
+        fut.set_exception(error)
+    else:
+        fut.set_result(result)
 
 
 def _fail_if_pending(fut: asyncio.Future, err: BaseException) -> None:

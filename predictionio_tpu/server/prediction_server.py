@@ -43,6 +43,10 @@ from predictionio_tpu.obs.logging import get_request_id
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
 from predictionio_tpu.obs.quality import QualityMonitor, default_quality
 from predictionio_tpu.obs.tracing import trace
+from predictionio_tpu.resilience import LoadShed
+from predictionio_tpu.resilience.admission import AdmissionController
+from predictionio_tpu.resilience.deadline import DeadlineExceeded
+from predictionio_tpu.resilience.degrade import degraded_scope
 from predictionio_tpu.server.httpd import (
     AppServer,
     HTTPApp,
@@ -50,6 +54,7 @@ from predictionio_tpu.server.httpd import (
     Response,
     error_response,
     json_response,
+    shed_response,
 )
 from predictionio_tpu.utils.params import extract_params
 
@@ -203,10 +208,35 @@ def create_prediction_server_app(
     drain_timeout_s: float = 5.0,
     registry: MetricsRegistry | None = None,
     quality: QualityMonitor | None = None,
+    #: queued queries past which /queries.json sheds 503 + Retry-After
+    #: (PIO_MAX_QUEUE); None = MicroBatcher's default bound (1024),
+    #: 0 or negative = unbounded (the legacy behavior)
+    max_queue: int | None = None,
+    #: in-flight request cap enforced at admission (PIO_MAX_INFLIGHT);
+    #: None disables the cap
+    max_inflight: int | None = None,
+    #: default per-request time budget in seconds, overridable per request
+    #: via the X-Pio-Deadline header (PIO_DEFAULT_DEADLINE_S)
+    default_deadline_s: float | None = None,
 ) -> HTTPApp:
+    import os
+
     from predictionio_tpu.server.plugins import PluginContext
 
     app = HTTPApp("predictionserver")
+    if max_queue is None and os.environ.get("PIO_MAX_QUEUE"):
+        max_queue = int(os.environ["PIO_MAX_QUEUE"])
+    if max_inflight is None and os.environ.get("PIO_MAX_INFLIGHT"):
+        max_inflight = int(os.environ["PIO_MAX_INFLIGHT"])
+    if default_deadline_s is None and os.environ.get("PIO_DEFAULT_DEADLINE_S"):
+        default_deadline_s = float(os.environ["PIO_DEFAULT_DEADLINE_S"])
+    #: the front ends read these (httpd.observe_request / aio): deadline
+    #: admission + binding, and the in-flight shed gate
+    app.default_deadline_s = default_deadline_s
+    if max_inflight is not None:
+        app.admission = AdmissionController(
+            max_inflight, registry=registry or REGISTRY
+        )
     feedback = feedback or FeedbackConfig()
     plugins = plugins or PluginContext.from_env()
     stats = {"request_count": 0, "avg_serving_sec": 0.0, "last_serving_sec": 0.0}
@@ -241,6 +271,16 @@ def create_prediction_server_app(
             return True
         return storage.l_events() is not None
 
+    def _storage_breakers_ok() -> bool:
+        # an OPEN breaker to any of this runtime's storage daemons flips
+        # /readyz: serving may continue (degraded), but operators and load
+        # balancers see the dependency outage.  Half-open reads as
+        # recovering and does not flip readiness.
+        storage = getattr(deployed, "storage", None)
+        if storage is None or not hasattr(storage, "breakers"):
+            return True
+        return all(br.state != "open" for br in storage.breakers())
+
     add_observability_routes(
         app,
         registry,
@@ -249,6 +289,7 @@ def create_prediction_server_app(
             "model_loaded": _model_loaded,
             "microbatcher": _batcher_ready,
             "event_store": _event_store_ready,
+            "storage_breakers": _storage_breakers_ok,
         },
         quality=quality,
     )
@@ -378,9 +419,17 @@ def create_prediction_server_app(
             whole wave into O(B) solo predicts."""
             try:
                 results = deployed.predict_batch([parsed[i][1] for i in idxs])
+            except DeadlineExceeded:
+                # the wave's bound budget (its TIGHTEST member's) ran out:
+                # not a poison query, so don't bisect — and don't fail the
+                # wave-mates, whose own budgets may be fine.  Re-raising
+                # hands the wave to the MicroBatcher's solo-retry pass,
+                # which re-runs each item under ITS OWN deadline: only
+                # genuinely-expired items 504
+                raise
             except Exception as e:
                 if len(idxs) == 1:
-                    out[idxs[0]] = ("err", e)
+                    out[idxs[0]] = ("err", e, ())
                     return
                 if depth == 0:
                     log.exception(
@@ -396,28 +445,36 @@ def create_prediction_server_app(
         def _serve_wave(payloads):
             """Whole wave on the worker thread: extract + vectorized predict
             + render/plugins/feedback.  Returns per item one of
-            ("ok", rendered) | ("bad", err) -> 400 | ("err", err) -> 500;
-            a poison query degrades only itself, never the rest of the
-            wave, and a plugin/feedback failure on one item never re-runs
-            prediction for the others."""
+            ("ok", rendered, degraded) | ("bad", err, ()) -> 400 |
+            ("err", err, ()) -> 500; a poison query degrades only itself,
+            never the rest of the wave, and a plugin/feedback failure on
+            one item never re-runs prediction for the others.  ``degraded``
+            carries wave-level fallback reasons (an engine that fell back
+            to model-only serving mid-wave marks every answer it produced
+            under that fallback)."""
             parsed: list[tuple[str, Any]] = []
-            for pl in payloads:
-                try:
-                    parsed.append(("q", deployed.extract_query(pl)))
-                except Exception as e:
-                    parsed.append(("bad", e))
-            out: list[Any] = list(parsed)
-            ok_idx = [i for i, (tag, _) in enumerate(parsed) if tag == "q"]
-            if ok_idx:
-                _predict_bisect(parsed, ok_idx, out)
-            for i, entry in enumerate(out):
-                if entry[0] != "pred":
-                    continue
-                q, pred = entry[1]
-                try:
-                    out[i] = ("ok", _postprocess(payloads[i], q, pred))
-                except Exception as e:  # plugin error: only this item fails
-                    out[i] = ("err", e)
+            with degraded_scope() as degraded:
+                for pl in payloads:
+                    try:
+                        parsed.append(("q", deployed.extract_query(pl)))
+                    except Exception as e:
+                        parsed.append(("bad", e))
+                out: list[Any] = [(tag, v, ()) for tag, v in parsed]
+                ok_idx = [i for i, (tag, _) in enumerate(parsed) if tag == "q"]
+                if ok_idx:
+                    _predict_bisect(parsed, ok_idx, out)
+                for i, entry in enumerate(out):
+                    if entry[0] != "pred":
+                        continue
+                    q, pred = entry[1]
+                    try:
+                        out[i] = (
+                            "ok",
+                            _postprocess(payloads[i], q, pred),
+                            tuple(degraded),
+                        )
+                    except Exception as e:  # plugin error: only this fails
+                        out[i] = ("err", e, ())
             return out
 
         batcher = MicroBatcher(
@@ -425,6 +482,12 @@ def create_prediction_server_app(
             max_batch=max_batch,
             drain_timeout_s=drain_timeout_s,
             registry=registry,
+            # None -> the batcher's default bound; 0/negative -> unbounded
+            **(
+                {"max_queue": max_queue if max_queue > 0 else None}
+                if max_queue is not None
+                else {}
+            ),
         )
         app.microbatcher = batcher  # exposed for tests/status introspection
 
@@ -453,7 +516,19 @@ def create_prediction_server_app(
             meta: dict[str, Any] = {}
             try:
                 with trace("serve.microbatch", record=False):
-                    status, value = await batcher.submit(payload, meta)
+                    status, value, degraded = await batcher.submit(
+                        payload, meta
+                    )
+            except LoadShed as e:
+                # bounded queue: shed instead of letting the backlog grow —
+                # clients get an honest 503 + Retry-After
+                _observe("/queries.json", 503, t0)
+                return shed_response(str(e), e.retry_after_s)
+            except DeadlineExceeded as e:
+                # the budget ran out while queued (or mid-wave): no point
+                # answering a client that already gave up
+                _observe("/queries.json", 504, t0)
+                return error_response(504, f"deadline exceeded: {e}")
             except Exception as e:
                 log.exception("query serving failed")
                 _observe("/queries.json", 500, t0)
@@ -479,7 +554,13 @@ def create_prediction_server_app(
                 wave_size=meta.get("wave_size"),
                 wave_seq=meta.get("wave_seq"),
             )
-            return json_response(200, value)
+            resp = json_response(200, value)
+            if degraded:
+                # answered from model-only fallback (event store down/over
+                # budget): correct-but-degraded, stamped so clients and
+                # probes can tell (metrics carry pio_degraded_total)
+                resp.headers["X-Pio-Degraded"] = ",".join(degraded)
+            return resp
 
     else:
 
@@ -492,12 +573,19 @@ def create_prediction_server_app(
                 _observe("/queries.json", 400, t0)
                 return error_response(400, f"invalid query: {e}")
             try:
-                query, prediction = deployed.predict(query)
+                with degraded_scope() as degraded:
+                    query, prediction = deployed.predict(query)
+            except DeadlineExceeded as e:
+                _observe("/queries.json", 504, t0)
+                return error_response(504, f"deadline exceeded: {e}")
             except Exception as e:
                 log.exception("query serving failed")
                 _observe("/queries.json", 500, t0)
                 return error_response(500, f"{type(e).__name__}: {e}")
-            return _finish_query(payload, query, prediction, t0)
+            resp = _finish_query(payload, query, prediction, t0)
+            if degraded:
+                resp.headers["X-Pio-Degraded"] = ",".join(degraded)
+            return resp
 
     def _authorized(req: Request) -> bool:
         return access_key is None or req.query.get("accessKey") == access_key
@@ -611,6 +699,9 @@ def create_prediction_server(
     access_key: str | None = None,
     server_kind: str = "aio",
     registry: MetricsRegistry | None = None,
+    max_queue: int | None = None,
+    max_inflight: int | None = None,
+    default_deadline_s: float | None = None,
 ):
     """Build the deploy server.
 
@@ -642,6 +733,9 @@ def create_prediction_server(
         access_key=access_key,
         use_microbatch=server_kind == "aio",
         registry=registry,
+        max_queue=max_queue,
+        max_inflight=max_inflight,
+        default_deadline_s=default_deadline_s,
     )
     if server_kind == "aio":
         from predictionio_tpu.server.aio import AsyncAppServer

@@ -339,6 +339,9 @@ def do_deploy(args) -> int:
             enabled=args.feedback, access_key=args.accesskey or None
         ),
         access_key=args.accesskey or None,
+        max_queue=getattr(args, "max_queue", None),
+        max_inflight=getattr(args, "max_inflight", None),
+        default_deadline_s=getattr(args, "deadline_s", None),
     )
     event_server = None
     if getattr(args, "event_port", None):
@@ -379,9 +382,21 @@ def do_undeploy(args) -> int:
             urllib.request.Request(url, method="POST"), timeout=10
         ) as r:
             print(r.read().decode())
+        print("undeployed via POST /stop")
         return 0
     except Exception as e:
-        print(f"undeploy failed: {e}", file=sys.stderr)
+        print(f"undeploy via POST /stop failed: {e}", file=sys.stderr)
+        if getattr(args, "pidfile", None):
+            # the HTTP surface is wedged but we own a pidfile: escalate
+            # through signals and report which one won
+            from predictionio_tpu.tools import daemon
+
+            won = daemon.stop_pidfile(args.pidfile)
+            _report_stop(Path(args.pidfile).stem, won)
+            # None = nothing was running: the desired end state (daemon
+            # down, pidfile gone) holds either way — that's a success,
+            # and it matches `pio stop`'s exit code for the same outcome
+            return 0
         return 1
 
 
@@ -539,6 +554,17 @@ def do_start_all(args) -> int:
     return 0
 
 
+def _report_stop(name: str, won: str | None) -> None:
+    """One line per daemon naming WHICH signal won — a daemon that needed
+    SIGKILL was wedged, and the operator should know."""
+    if won == "TERM":
+        print(f"{name}: stopped (SIGTERM)")
+    elif won == "KILL":
+        print(f"{name}: ignored SIGTERM past the deadline; killed (SIGKILL)")
+    else:
+        print(f"{name}: was not running")
+
+
 def do_stop_all(args) -> int:
     """`pio stop-all` (bin/pio-stop-all): stop every pidfile-tracked
     daemon."""
@@ -547,8 +573,29 @@ def do_stop_all(args) -> int:
     stopped = daemon.stop_all()
     if not stopped:
         print("Nothing to stop.")
-    for name, was_running in stopped.items():
-        print(f"{name}: {'stopped' if was_running else 'was not running'}")
+    for name, won in stopped.items():
+        _report_stop(name, won)
+    return 0
+
+
+def do_stop(args) -> int:
+    """`pio stop <name-or-pidfile>`: stop ONE pidfile-tracked daemon
+    (eventserver / adminserver / dashboard / storageserver, or any pidfile
+    `pio daemon` wrote), escalating SIGTERM -> SIGKILL past --timeout."""
+    from predictionio_tpu.tools import daemon
+
+    # only an EXPLICIT pidfile spelling (.pid suffix or a path separator)
+    # is treated as a path; bare names always map to $PIO_HOME/pids/ — a
+    # stray file named `eventserver` in the cwd must never be unlinked
+    if args.name.endswith(".pid") or os.sep in args.name:
+        target = Path(args.name)
+    else:
+        target = daemon.pio_home() / "pids" / f"{args.name}.pid"
+    if not target.is_file():
+        print(f"no pidfile at {target}", file=sys.stderr)
+        return 1
+    won = daemon.stop_pidfile(target, timeout=args.timeout)
+    _report_stop(target.stem, won)
     return 0
 
 
@@ -998,12 +1045,40 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="skip the static DASE contract pre-flight",
     )
+    dp.add_argument(
+        "--deadline-s",
+        type=float,
+        default=None,
+        help="default per-request time budget in seconds (clients override "
+        "per request with the X-Pio-Deadline header); expired work is "
+        "answered 504 instead of computed (PIO_DEFAULT_DEADLINE_S)",
+    )
+    dp.add_argument(
+        "--max-inflight",
+        type=int,
+        default=None,
+        help="in-flight request cap; excess requests shed with 503 + "
+        "Retry-After at admission (PIO_MAX_INFLIGHT)",
+    )
+    dp.add_argument(
+        "--max-queue",
+        type=int,
+        default=None,
+        help="micro-batch queue bound; excess queries shed with 503 + "
+        "Retry-After (PIO_MAX_QUEUE; default 1024, 0 = unbounded)",
+    )
     dp.set_defaults(fn=do_deploy)
 
     ud = sub.add_parser("undeploy")
     ud.add_argument("--ip", default="127.0.0.1")
     ud.add_argument("--port", type=int, default=8000)
     ud.add_argument("--accesskey", default="")
+    ud.add_argument(
+        "--pidfile",
+        default=None,
+        help="fall back to SIGTERM->SIGKILL via this pidfile when the HTTP "
+        "/stop surface is wedged (reports which signal won)",
+    )
     ud.set_defaults(fn=do_undeploy)
 
     bp = sub.add_parser("batchpredict")
@@ -1062,6 +1137,20 @@ def build_parser() -> argparse.ArgumentParser:
 
     st = sub.add_parser("stop-all")
     st.set_defaults(fn=do_stop_all)
+
+    sp = sub.add_parser("stop")
+    sp.add_argument(
+        "name",
+        help="daemon name (eventserver, adminserver, dashboard, "
+        "storageserver) or a pidfile path",
+    )
+    sp.add_argument(
+        "--timeout",
+        type=float,
+        default=10.0,
+        help="seconds to wait for SIGTERM before escalating to SIGKILL",
+    )
+    sp.set_defaults(fn=do_stop)
 
     up = sub.add_parser("upgrade")
     up.set_defaults(fn=do_upgrade)

@@ -100,22 +100,36 @@ def pid_alive(pid: int | None) -> bool:
     return b"predictionio_tpu" in cmdline
 
 
-def stop_pidfile(pidfile: Path | str, timeout: float = 10.0) -> bool:
-    """SIGTERM the recorded pid (if still ours), wait for exit, remove the
-    pidfile."""
+def _wait_exit(pid: int, timeout: float) -> bool:
+    """Poll (cross-process: nothing to wait on) until pid dies or timeout."""
+    deadline = time.monotonic() + timeout
+    while pid_alive(pid) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    return not pid_alive(pid)
+
+
+def stop_pidfile(pidfile: Path | str, timeout: float = 10.0) -> str | None:
+    """Stop the recorded pid (if still ours) and remove the pidfile.
+
+    SIGTERM first; a daemon that ignores it past ``timeout`` (wedged device
+    dispatch, stuck shutdown hook) is escalated to SIGKILL instead of being
+    left running behind a deleted pidfile.  Returns which signal won —
+    ``"TERM"`` (clean exit), ``"KILL"`` (escalated) — or None when nothing
+    was running, so ``pio stop``/``pio stop-all`` can report it.
+    """
     pidfile = Path(pidfile)
     pid = read_pidfile(pidfile)
-    stopped = False
+    won: str | None = None
     if pid_alive(pid):
         os.kill(pid, signal.SIGTERM)
-        deadline = time.monotonic() + timeout
-        while pid_alive(pid) and time.monotonic() < deadline:
-            time.sleep(0.05)
-        if pid_alive(pid):
+        if _wait_exit(pid, timeout):
+            won = "TERM"
+        else:
             os.kill(pid, signal.SIGKILL)
-        stopped = True
+            _wait_exit(pid, 2.0)  # reap window; SIGKILL cannot be ignored
+            won = "KILL"
     pidfile.unlink(missing_ok=True)
-    return stopped
+    return won
 
 
 #: the single-node service stack and its default ports (pio-start-all)
@@ -217,9 +231,10 @@ def start_all(
     return pids
 
 
-def stop_all() -> dict[str, bool]:
+def stop_all() -> dict[str, str | None]:
     """Stop every pidfile under $PIO_HOME/pids (not just the stack names,
-    so `pio daemon` one-offs are reaped too)."""
+    so `pio daemon` one-offs are reaped too).  Values are the winning
+    signal per daemon ("TERM"/"KILL") or None for not-running."""
     out = {}
     for pidfile in sorted(_pid_dir().glob("*.pid")):
         out[pidfile.stem] = stop_pidfile(pidfile)

@@ -1,0 +1,30 @@
+"""Fixture: PIO-RES001 — network calls without an explicit timeout."""
+
+import urllib.request
+from urllib.request import urlopen
+
+
+def fetch_bad(url):
+    return urllib.request.urlopen(url).read()  # line 8: RES001 (no timeout)
+
+
+def fetch_bad_alias(url):
+    return urlopen(url).read()  # line 12: RES001 (aliased import)
+
+
+def fetch_good(url):
+    return urllib.request.urlopen(url, timeout=10).read()  # clean
+
+
+def fetch_kwargs(url, **kw):
+    return urllib.request.urlopen(url, **kw).read()  # clean: may carry it
+
+
+def fetch_positional(url):
+    return urllib.request.urlopen(url, None, 5).read()  # clean: positional
+
+
+def connect_positional(host):
+    import socket
+
+    return socket.create_connection((host, 80), 5)  # clean: positional

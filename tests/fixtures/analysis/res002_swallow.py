@@ -1,0 +1,27 @@
+"""Fixture: PIO-RES002 — silent exception swallowing on serving paths."""
+
+
+def predict(model, query):
+    try:
+        seen = model.store.find(query.user)
+    except Exception:  # line 7: RES002 (hot path, silent)
+        pass
+    try:
+        extra = model.store.recent(query.user)
+    except Exception:
+        extra = []  # clean: the handler does something (fallback value)
+    return seen, extra
+
+
+def batch_fn(items):
+    try:
+        return [i * 2 for i in items]
+    except:  # noqa: E722  line 19: RES002 (bare except, hot fragment)
+        ...
+
+
+def load_config(path):
+    try:
+        return open(path).read()
+    except Exception:  # clean: not a serving hot path
+        pass

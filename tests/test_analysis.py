@@ -75,6 +75,18 @@ class TestRuleCorpus:
             ("PIO-CONC003", 21, "high"),
         ]
 
+    def test_res001_urlopen_without_timeout(self):
+        assert triples("res001_timeout.py") == [
+            ("PIO-RES001", 8, "medium"),
+            ("PIO-RES001", 12, "medium"),
+        ]
+
+    def test_res002_silent_swallow_on_hot_path(self):
+        assert triples("res002_swallow.py") == [
+            ("PIO-RES002", 7, "high"),
+            ("PIO-RES002", 19, "high"),
+        ]
+
     def test_every_shipped_rule_has_fixture_coverage(self):
         """The corpus exercises every registered AST rule."""
         seen = {
@@ -88,6 +100,8 @@ class TestRuleCorpus:
                 "conc001_async.py",
                 "conc002_poll.py",
                 "conc003_lock.py",
+                "res001_timeout.py",
+                "res002_swallow.py",
             )
             for f in findings_for(name)
         }

@@ -1,0 +1,591 @@
+"""Chaos end-to-end: kill the storage daemon mid-traffic and prove the
+serving path degrades instead of stalling; shed under synthetic overload;
+expire queued work past its deadline.
+
+The acceptance scenario for the resilience layer (docs/robustness.md):
+with traffic flowing, the storage daemon dies — serving keeps answering in
+degraded mode with latency bounded far under the old 30 s transport stall,
+the breaker opens (``pio_breaker_state`` flips, ``/readyz`` and
+``pio status`` report it); the daemon comes back — the breaker half-opens
+on the next trial and closes, and degraded marking stops.  Everything is
+event-synchronized or breaker-clocked; the only real waits are the
+(sub-second) breaker reset window and actual server round trips.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from predictionio_tpu.resilience import faults
+from predictionio_tpu.resilience.breaker import reset_breakers
+
+
+@pytest.fixture(autouse=True)
+def _isolate_process_globals():
+    reset_breakers()
+    faults.clear()
+    yield
+    reset_breakers()
+    faults.clear()
+
+
+def _post(url: str, payload: dict, headers: dict | None = None):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+def _get(url: str):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _spawn_storage_daemon(root, port):
+    """The storage daemon as a REAL subprocess so killing it severs every
+    keep-alive connection, exactly like a crashed storage host — an
+    in-process shutdown() only closes the listener and leaves per-
+    connection handler threads answering."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "predictionio_tpu.tools.cli",
+            "storageserver",
+            "--ip",
+            "127.0.0.1",
+            "--port",
+            str(port),
+            "--root",
+            str(root),
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        env=env,
+    )
+    deadline_t = time.monotonic() + 60
+    while time.monotonic() < deadline_t:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return proc
+        except OSError:
+            if proc.poll() is not None:
+                raise RuntimeError("storage daemon subprocess died at boot")
+            time.sleep(0.1)
+    proc.kill()
+    raise TimeoutError("storage daemon subprocess never bound its port")
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestStorageDaemonDeathAndRevival:
+    """The headline chaos run: ecommerce (live event-store reads on the
+    hot path) served over a remote storage daemon that dies and returns."""
+
+    BREAKER_RESET_S = 0.4
+
+    @pytest.fixture()
+    def stack(self, tmp_path):
+        import predictionio_tpu.models  # noqa: F401  register factories
+        from predictionio_tpu.core.base import EngineContext
+        from predictionio_tpu.core.engine import resolve_engine_factory
+        from predictionio_tpu.core.workflow import run_train
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage.config import (
+            StorageConfig,
+            reset_storage,
+        )
+        from predictionio_tpu.tools import commands as cmd
+
+        daemon_port = _free_port()
+        daemon_proc = _spawn_storage_daemon(tmp_path / "root", daemon_port)
+        # the ecommerce serving context reads through the PROCESS-global
+        # runtime (EngineContext(mode="serving")), so configure that
+        cfg = StorageConfig.from_env(
+            {
+                "PIO_HOME": str(tmp_path / "client_home"),
+                "PIO_STORAGE_SOURCES_R_TYPE": "remote",
+                "PIO_STORAGE_SOURCES_R_URL": f"http://127.0.0.1:{daemon_port}",
+                "PIO_STORAGE_SOURCES_R_TIMEOUT": "5.0",
+                "PIO_STORAGE_SOURCES_R_RETRIES": "2",
+                "PIO_STORAGE_SOURCES_R_BREAKER_THRESHOLD": "2",
+                "PIO_STORAGE_SOURCES_R_BREAKER_RESET_S": str(
+                    self.BREAKER_RESET_S
+                ),
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "R",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "R",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "R",
+            }
+        )
+        rt = reset_storage(cfg)
+        app = cmd.app_new(rt, "chaos").app
+        levents = rt.l_events()
+        for i in range(8):
+            levents.insert(
+                Event(
+                    event="$set",
+                    entity_type="user",
+                    entity_id=f"u{i}",
+                    properties=DataMap({"name": f"user {i}"}),
+                ),
+                app.id,
+            )
+        # catalog larger than any one user's history so seen-filtering
+        # still leaves candidates (unseenOnly is the default)
+        for i in range(24):
+            levents.insert(
+                Event(
+                    event="$set",
+                    entity_type="item",
+                    entity_id=f"i{i}",
+                    properties=DataMap({"categories": ["c1"]}),
+                ),
+                app.id,
+            )
+        for n in range(120):
+            levents.insert(
+                Event(
+                    event="view" if n % 3 else "buy",
+                    entity_type="user",
+                    entity_id=f"u{n % 8}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{(n * 5 + n // 8) % 24}",
+                    properties=DataMap({}),
+                ),
+                app.id,
+            )
+        engine = resolve_engine_factory("ecommerce")()
+        params = engine.params_from_json(
+            {
+                "datasource": {"params": {"appName": "chaos"}},
+                "algorithms": [
+                    {
+                        "name": "ecomm",
+                        "params": {
+                            "appName": "chaos",
+                            "rank": 4,
+                            "numIterations": 2,
+                        },
+                    }
+                ],
+            }
+        )
+        run_train(
+            engine,
+            params,
+            ctx=EngineContext(storage=rt, mode="train"),
+            engine_factory="ecommerce",
+            storage=rt,
+        )
+        from predictionio_tpu.server.prediction_server import (
+            create_prediction_server,
+        )
+
+        server = create_prediction_server(
+            "ecommerce", host="127.0.0.1", port=0, storage=rt
+        ).start_background()
+        try:
+            yield daemon_proc, rt, server, tmp_path, daemon_port
+        finally:
+            server.shutdown()
+            if daemon_proc.poll() is None:
+                daemon_proc.kill()
+                daemon_proc.wait(timeout=10)
+            reset_storage(
+                StorageConfig.from_env(
+                    {"PIO_HOME": str(tmp_path / "post_home")}
+                )
+            )
+
+    def test_kill_revive_breaker_and_degraded_mode(self, stack, capsys):
+        from predictionio_tpu.tools.cli import main as cli_main
+
+        daemon_proc, rt, server, tmp_path, daemon_port = stack
+        base = f"http://127.0.0.1:{server.port}"
+
+        # -- phase 1: healthy --------------------------------------------
+        status, body, headers = _post(
+            base + "/queries.json", {"user": "u1", "num": 3}
+        )
+        assert status == 200 and len(body["itemScores"]) == 3
+        assert headers.get("X-Pio-Degraded") is None
+        healthy_scores = body
+        assert _get(base + "/readyz")[0] == 200
+
+        # -- phase 2: the storage fleet dies mid-traffic (SIGKILL: every
+        # connection severed, like a crashed host) -------------------------
+        daemon_proc.kill()
+        daemon_proc.wait(timeout=10)
+        latencies = []
+        for i in range(6):
+            t0 = time.perf_counter()
+            status, body, headers = _post(
+                base + "/queries.json", {"user": f"u{i % 4}", "num": 3}
+            )
+            latencies.append(time.perf_counter() - t0)
+            # serving KEEPS ANSWERING: model-only, marked degraded
+            assert status == 200, body
+            assert len(body["itemScores"]) == 3
+            assert "seen_filter" in headers["X-Pio-Degraded"]
+        # p99 bound: nothing waited on a dead daemon's transport timeout
+        assert max(latencies) < 5.0
+        # once the breaker is open the fallback is ~free
+        assert min(latencies[2:]) < 0.5
+        # the same model answers as before the outage (degraded = weaker
+        # filtering, not different scoring for an all-seen-filterable user)
+        assert [s["item"] for s in body["itemScores"]]
+
+        breakers = rt.breakers()
+        assert len(breakers) == 1
+        assert breakers[0].state == "open"
+        endpoint = f"storage:127.0.0.1:{daemon_port}"
+        # the gauge flipped on the process registry -> /metrics
+        status, raw = _get(base + "/metrics")
+        assert f'pio_breaker_state{{endpoint="{endpoint}"}} 2' in raw.decode()
+        # /readyz reports the dependency outage (degraded serving continues)
+        status, raw = _get(base + "/readyz")
+        assert status == 503
+        checks = json.loads(raw)["checks"]
+        assert checks["storage_breakers"] is False
+        assert checks["model_loaded"] is True
+        # /slo.json carries the breaker block; pio status exits nonzero
+        status, raw = _get(base + "/slo.json")
+        assert json.loads(raw)["breakers"][endpoint]["state"] in (
+            "open",
+            "half_open",
+        )
+        assert cli_main(["status", "--url", base, "--no-quality"]) == 1
+        capsys.readouterr()
+        # degraded counters moved
+        from predictionio_tpu.obs.metrics import REGISTRY
+
+        assert REGISTRY.get("pio_degraded_total").labels(
+            "seen_filter"
+        ).value >= 6
+
+        # -- phase 3: the daemon comes back -------------------------------
+        revived = _spawn_storage_daemon(tmp_path / "root", daemon_port)
+        try:
+            time.sleep(self.BREAKER_RESET_S + 0.2)  # open -> half-open
+            degraded_before = (
+                REGISTRY.get("pio_degraded_total").labels("seen_filter").value
+            )
+            status, body, headers = _post(
+                base + "/queries.json", {"user": "u1", "num": 3}
+            )
+            assert status == 200
+            # the half-open trial succeeded: breaker closed, no degradation
+            assert headers.get("X-Pio-Degraded") is None
+            assert breakers[0].state == "closed"
+            assert body == healthy_scores  # identical full-fidelity answer
+            # degraded counters STOPPED moving
+            status, body, headers = _post(
+                base + "/queries.json", {"user": "u2", "num": 3}
+            )
+            assert headers.get("X-Pio-Degraded") is None
+            assert (
+                REGISTRY.get("pio_degraded_total").labels("seen_filter").value
+                == degraded_before
+            )
+            assert _get(base + "/readyz")[0] == 200
+            assert (
+                cli_main(["status", "--url", base, "--no-quality"]) == 0
+            )
+            capsys.readouterr()
+            status, raw = _get(base + "/metrics")
+            assert (
+                f'pio_breaker_state{{endpoint="{endpoint}"}} 0'
+                in raw.decode()
+            )
+        finally:
+            revived.kill()
+            revived.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# synthetic overload + deadlines against a stub engine (no storage, no jax)
+
+
+def _stub_server(**app_kwargs):
+    import types
+
+    from predictionio_tpu.core.base import Algorithm, FirstServing
+    from predictionio_tpu.server.aio import AsyncAppServer
+    from predictionio_tpu.server.prediction_server import (
+        DeployedEngine,
+        create_prediction_server_app,
+    )
+
+    class SlowAlgo(Algorithm):
+        def train(self, ctx, pd):
+            return None
+
+        def predict(self, model, q):
+            time.sleep(q.get("sleep", 0.0))
+            return {"echo": q["user"]}
+
+        def batch_predict(self, model, iq):
+            return [(i, self.predict(model, q)) for i, q in iq]
+
+    deployed = DeployedEngine.__new__(DeployedEngine)
+    deployed._lock = threading.RLock()
+    deployed.instance = types.SimpleNamespace(id="chaos-stub")
+    deployed.storage = None
+    deployed.algorithms = [SlowAlgo()]
+    deployed.models = [None]
+    deployed.serving = FirstServing()
+    deployed.extract_query = lambda payload: dict(payload)
+    app = create_prediction_server_app(
+        deployed, use_microbatch=True, **app_kwargs
+    )
+    return AsyncAppServer(app, "127.0.0.1", 0).start_background()
+
+
+def _post_or_error(url, payload, headers=None):
+    try:
+        return _post(url, payload, headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+class TestOverloadShedding:
+    def test_bounded_queue_sheds_while_admitted_complete(self):
+        from predictionio_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        server = _stub_server(max_batch=1, max_queue=2, registry=reg)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            payloads = [
+                {"user": f"u{i}", "sleep": 0.15} for i in range(12)
+            ]
+            with ThreadPoolExecutor(12) as ex:
+                results = list(
+                    ex.map(
+                        lambda p: _post_or_error(
+                            base + "/queries.json", p
+                        ),
+                        payloads,
+                    )
+                )
+            shed = [r for r in results if r[0] == 503]
+            served = [r for r in results if r[0] == 200]
+            assert served and shed, [r[0] for r in results]
+            assert {r[0] for r in results} <= {200, 503}
+            for code, body, headers in shed:
+                assert int(headers["Retry-After"]) >= 1
+                assert "queue full" in body["message"]
+            for i, (code, body, _h) in enumerate(results):
+                if code == 200:  # admitted requests answer CORRECTLY
+                    assert body == {"echo": payloads[i]["user"]}
+            assert reg.get("pio_shed_total").labels("queue").value == len(
+                shed
+            )
+        finally:
+            server.shutdown()
+
+    def test_inflight_cap_sheds_at_admission(self):
+        from predictionio_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        server = _stub_server(max_inflight=2, registry=reg)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            with ThreadPoolExecutor(8) as ex:
+                results = list(
+                    ex.map(
+                        lambda i: _post_or_error(
+                            base + "/queries.json",
+                            {"user": f"u{i}", "sleep": 0.2},
+                        ),
+                        range(8),
+                    )
+                )
+            codes = [r[0] for r in results]
+            assert 503 in codes and 200 in codes
+            shed = [r for r in results if r[0] == 503]
+            assert all("Retry-After" in h for _c, _b, h in shed)
+            assert (
+                reg.get("pio_shed_total").labels("inflight").value
+                == len(shed)
+            )
+            # probes stay open during overload: admission skips obs paths
+            assert _get(base + "/healthz")[0] == 200
+        finally:
+            server.shutdown()
+
+
+class TestDeadlineEndToEnd:
+    def test_expired_at_admission_is_504(self):
+        server = _stub_server()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            code, body, _h = _post_or_error(
+                base + "/queries.json",
+                {"user": "u1"},
+                headers={"X-Pio-Deadline": "0"},
+            )
+            assert code == 504 and "deadline" in body["message"]
+        finally:
+            server.shutdown()
+
+    def test_queued_request_expires_instead_of_dispatching(self):
+        server = _stub_server(max_batch=1)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            with ThreadPoolExecutor(2) as ex:
+                slow = ex.submit(
+                    _post_or_error,
+                    base + "/queries.json",
+                    {"user": "hold", "sleep": 0.4},
+                )
+                time.sleep(0.1)  # wave 1 in flight
+                doomed = ex.submit(
+                    _post_or_error,
+                    base + "/queries.json",
+                    {"user": "late"},
+                    {"X-Pio-Deadline": "0.05"},  # expires while queued
+                )
+                code, body, _h = doomed.result()
+                assert code == 504, body
+                assert "deadline" in body["message"]
+                code, body, _h = slow.result()
+                assert code == 200 and body == {"echo": "hold"}
+        finally:
+            server.shutdown()
+
+    def _deadline_checking_server(self, calls):
+        """A server whose engine checks the bound deadline after its
+        (simulated) work — the shape of a RemoteClient call on the hot
+        path."""
+        import types
+
+        from predictionio_tpu.core.base import Algorithm, FirstServing
+        from predictionio_tpu.resilience import deadline as dl
+        from predictionio_tpu.server.aio import AsyncAppServer
+        from predictionio_tpu.server.prediction_server import (
+            DeployedEngine,
+            create_prediction_server_app,
+        )
+
+        class DeadlineAlgo(Algorithm):
+            def train(self, ctx, pd):
+                return None
+
+            def predict(self, model, q):
+                time.sleep(q.get("sleep", 0.0))
+                dl.check("engine storage call")
+                return {"echo": q["user"]}
+
+            def batch_predict(self, model, iq):
+                calls["batch"] += 1
+                return [(i, self.predict(model, q)) for i, q in iq]
+
+        deployed = DeployedEngine.__new__(DeployedEngine)
+        deployed._lock = threading.RLock()
+        deployed.instance = types.SimpleNamespace(id="expire-stub")
+        deployed.storage = None
+        deployed.algorithms = [DeadlineAlgo()]
+        deployed.models = [None]
+        deployed.serving = FirstServing()
+        deployed.extract_query = lambda payload: dict(payload)
+        app = create_prediction_server_app(deployed, use_microbatch=True)
+        return AsyncAppServer(app, "127.0.0.1", 0).start_background()
+
+    def test_wave_deadline_expiry_is_504_without_bisection_storm(self):
+        """Review regression: an engine storage call raising
+        DeadlineExceeded mid-wave maps to 504 (the documented shape) and
+        does NOT get treated as a poison query — no bisection re-dispatch
+        with a budget that is already gone."""
+        calls = {"batch": 0}
+        server = self._deadline_checking_server(calls)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            code, body, _h = _post_or_error(
+                base + "/queries.json",
+                {"user": "u1", "sleep": 0.1},
+                headers={"X-Pio-Deadline": "0.05"},
+            )
+            assert code == 504, body
+            assert "deadline" in body["message"]
+            assert calls["batch"] == 1  # no bisection re-dispatch
+        finally:
+            server.shutdown()
+
+    def test_wave_mates_survive_one_members_expired_deadline(self):
+        """Review regression: when the wave's tightest deadline expires
+        mid-batch, only THAT member 504s — a coalesced wave-mate with no
+        deadline is re-run under its own (absent) budget and answers 200."""
+        calls = {"batch": 0}
+        server = self._deadline_checking_server(calls)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            with ThreadPoolExecutor(3) as ex:
+                warm = ex.submit(
+                    _post_or_error,
+                    base + "/queries.json",
+                    {"user": "warm", "sleep": 0.15},  # holds wave 1
+                )
+                time.sleep(0.05)
+                # these two coalesce into wave 2, bound to A's deadline
+                a = ex.submit(
+                    _post_or_error,
+                    base + "/queries.json",
+                    {"user": "a", "sleep": 0.3},
+                    {"X-Pio-Deadline": "0.25"},
+                )
+                b = ex.submit(
+                    _post_or_error,
+                    base + "/queries.json",
+                    {"user": "b"},
+                )
+                code, body, _h = warm.result()
+                assert code == 200
+                code, body, _h = a.result()
+                assert code == 504, body  # A's own budget ran out
+                code, body, _h = b.result()
+                assert code == 200 and body == {"echo": "b"}  # B unharmed
+        finally:
+            server.shutdown()
+
+    def test_malformed_deadline_header_is_ignored(self):
+        server = _stub_server()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            code, body, _h = _post_or_error(
+                base + "/queries.json",
+                {"user": "u1"},
+                headers={"X-Pio-Deadline": "soon-ish"},
+            )
+            assert code == 200 and body == {"echo": "u1"}
+        finally:
+            server.shutdown()

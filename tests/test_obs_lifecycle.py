@@ -692,6 +692,7 @@ class TestServerHealthSurface:
             "model_loaded": True,
             "microbatcher": True,
             "event_store": True,
+            "storage_breakers": True,
         }
         app.microbatcher.close()  # draining: stop routing traffic here
         r = app.handle(Request("GET", "/readyz", {}, {}))

@@ -1,0 +1,984 @@
+"""Resilience-layer tests: deadlines, circuit breakers, retry policy,
+deterministic fault injection, micro-batch shedding/expiry/solo-retry,
+RemoteClient transport resilience, event-server 503s, and the SIGTERM ->
+SIGKILL stop escalation.
+
+Deterministic by construction: breaker transitions run on a frozen clock,
+fault plans are seeded, and every concurrency test synchronizes on events
+rather than sleeping and hoping.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.resilience import LoadShed, faults
+from predictionio_tpu.resilience.breaker import (
+    CircuitBreaker,
+    CircuitOpen,
+    breaker_states,
+    get_breaker,
+    reset_breakers,
+)
+from predictionio_tpu.resilience import breaker as breaker_mod
+from predictionio_tpu.resilience import deadline
+from predictionio_tpu.resilience.deadline import (
+    DeadlineExceeded,
+    deadline_scope,
+    parse_budget,
+)
+from predictionio_tpu.resilience.degrade import degraded_scope, mark_degraded
+from predictionio_tpu.resilience.retry import RetryBudget, RetryPolicy
+from predictionio_tpu.server.microbatch import MicroBatcher
+
+
+@pytest.fixture(autouse=True)
+def _isolate_process_globals():
+    """Breakers are process-global (endpoint-keyed) and fault plans are
+    process-wide: both must not leak across tests."""
+    reset_breakers()
+    faults.clear()
+    yield
+    reset_breakers()
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+
+
+class TestDeadline:
+    def test_scope_binds_and_restores(self):
+        assert deadline.get_deadline() is None
+        with deadline_scope(budget_s=10.0):
+            rem = deadline.remaining()
+            assert rem is not None and 9.0 < rem <= 10.0
+            assert not deadline.expired()
+            with deadline_scope(budget_s=0.5):  # nested, tighter
+                assert deadline.remaining() < 1.0
+            assert deadline.remaining() > 9.0
+        assert deadline.get_deadline() is None
+        assert deadline.remaining() is None
+
+    def test_expired_and_check(self):
+        with deadline_scope(budget_s=-0.001):
+            assert deadline.expired()
+            with pytest.raises(DeadlineExceeded):
+                deadline.check("unit op")
+
+    def test_noop_scope(self):
+        with deadline_scope():
+            assert deadline.get_deadline() is None
+
+    def test_parse_budget(self):
+        assert parse_budget("0.25") == 0.25
+        assert parse_budget("10") == 10.0
+        assert parse_budget("") is None
+        assert parse_budget(None) is None
+        assert parse_budget("banana") is None  # typo != 500
+        assert parse_budget("nan") is None
+        assert parse_budget("inf") is None
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (frozen clock: no real sleeps)
+
+
+class TestCircuitBreaker:
+    @pytest.fixture()
+    def clock(self, monkeypatch):
+        state = {"t": 1000.0}
+        monkeypatch.setattr(breaker_mod, "_now", lambda: state["t"])
+        return state
+
+    def test_full_lifecycle(self, clock):
+        reg = MetricsRegistry()
+        br = CircuitBreaker(
+            "ep", failure_threshold=3, reset_timeout_s=5.0, registry=reg
+        )
+        gauge = reg.get("pio_breaker_state").labels("ep")
+        assert br.state == "closed" and gauge.value == 0
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "closed"  # under threshold
+        br.record_failure()
+        assert br.state == "open" and gauge.value == 2
+        assert not br.allow()  # rejected in ~0 ms
+        assert 0 < br.retry_after_s() <= 5.0
+        with pytest.raises(CircuitOpen):
+            br.guard("op")
+        # reset window passes -> half-open admits ONE trial
+        clock["t"] += 5.0
+        assert br.state == "half_open"
+        assert br.allow() and gauge.value == 1
+        assert not br.allow()  # second concurrent trial rejected
+        br.record_success()
+        assert br.state == "closed" and gauge.value == 0
+
+    def test_half_open_failure_reopens(self, clock):
+        br = CircuitBreaker(
+            "ep2",
+            failure_threshold=1,
+            reset_timeout_s=5.0,
+            registry=MetricsRegistry(),
+        )
+        br.record_failure()
+        assert br.state == "open"
+        clock["t"] += 5.0
+        assert br.allow()  # half-open trial
+        br.record_failure()  # trial failed: straight back to open
+        assert br.state == "open"
+        assert not br.allow()  # clock restarted
+        assert br.snapshot()["opened_total"] == 2
+
+    def test_abandoned_trial_releases_its_slot(self, clock):
+        """Review regression: a half-open trial that ends with NEITHER a
+        success nor an endpoint failure (deadline ran out mid-call) must
+        release its slot — leaking it wedges the breaker half-open with no
+        slots until process restart."""
+        br = CircuitBreaker(
+            "ep-rel",
+            failure_threshold=1,
+            reset_timeout_s=5.0,
+            registry=MetricsRegistry(),
+        )
+        br.record_failure()
+        clock["t"] += 5.0
+        assert br.allow()  # the one half-open trial slot is consumed
+        br.release_trial()  # caller abandoned it (e.g. DeadlineExceeded)
+        assert br.allow()  # recovery probing continues
+        br.record_success()
+        assert br.state == "closed"
+
+    def test_success_resets_failure_streak(self, clock):
+        br = CircuitBreaker(
+            "ep3", failure_threshold=2, registry=MetricsRegistry()
+        )
+        br.record_failure()
+        br.record_success()  # streak broken
+        br.record_failure()
+        assert br.state == "closed"
+
+    def test_registry_shares_by_name(self):
+        a = get_breaker("storage:h:1", failure_threshold=1)
+        b = get_breaker("storage:h:1", failure_threshold=9)
+        assert a is b and a.failure_threshold == 1  # first creation wins
+        a.record_failure()
+        snap = breaker_states()
+        assert snap["storage:h:1"]["state"] == "open"
+
+
+# ---------------------------------------------------------------------------
+# retry policy + budget
+
+
+class TestRetry:
+    def test_backoff_is_bounded_and_jittered(self):
+        import random
+
+        policy = RetryPolicy(
+            max_attempts=5, base_backoff_s=0.05, max_backoff_s=1.0
+        )
+        rng = random.Random(7)
+        prev = 0.0
+        seq = []
+        for _ in range(20):
+            prev = policy.backoff_s(prev, rng)
+            assert 0.05 <= prev <= 1.0
+            seq.append(prev)
+        # seeded: the exact sequence reproduces
+        rng2 = random.Random(7)
+        prev2 = 0.0
+        seq2 = []
+        for _ in range(20):
+            prev2 = policy.backoff_s(prev2, rng2)
+            seq2.append(prev2)
+        assert seq == seq2
+        assert len(set(round(s, 6) for s in seq)) > 5  # actually jittered
+
+    def test_invalid_policy(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0)
+
+    def test_budget_caps_retry_volume(self):
+        budget = RetryBudget(cap=2.0, deposit_per_call=0.5)
+        assert budget.try_spend() and budget.try_spend()  # starts full
+        assert not budget.try_spend()  # exhausted
+        for _ in range(2):  # two successful calls deposit 1.0
+            budget.record_call()
+        assert budget.try_spend()
+        assert not budget.try_spend()
+
+
+# ---------------------------------------------------------------------------
+# fault injector
+
+
+class TestFaultInjector:
+    def test_plan_is_deterministic(self):
+        def run_once():
+            inj = faults.FaultInjector(
+                [
+                    faults.FaultRule(
+                        seam="s", kind="error", probability=0.5, count=3
+                    )
+                ],
+                seed=42,
+            )
+            hits = []
+            for i in range(10):
+                try:
+                    inj.check("s", f"call{i}")
+                    hits.append(0)
+                except faults.FaultInjected:
+                    hits.append(1)
+            return hits
+
+        a, b = run_once(), run_once()
+        assert a == b and sum(a) == 3
+
+    def test_after_count_and_match(self):
+        inj = faults.install(
+            [
+                {
+                    "seam": "remote.send",
+                    "kind": "connection_reset",
+                    "match": "GET /v1/apps",
+                    "after": 1,
+                    "count": 1,
+                }
+            ]
+        )
+        inj.check("remote.send", "GET /v1/ping")  # no match: clean
+        inj.check("remote.send", "GET /v1/apps")  # first match skipped
+        with pytest.raises(ConnectionResetError):
+            inj.check("remote.send", "GET /v1/apps")
+        inj.check("remote.send", "GET /v1/apps")  # count exhausted
+        assert inj.snapshot()[0]["fired"] == 1
+
+    def test_latency_kind_sleeps_then_proceeds(self):
+        slept = []
+        inj = faults.FaultInjector(
+            [faults.FaultRule(seam="s", kind="latency", latency_s=0.25)],
+            sleep=slept.append,
+        )
+        inj.check("s", "x")  # no raise
+        assert slept == [0.25]
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            faults.FaultRule(seam="s", kind="explode")
+
+    def test_env_plan_roundtrip(self):
+        inj = faults.load_env_plan(
+            {
+                "PIO_FAULT_PLAN": '[{"seam": "s", "kind": "timeout"}]',
+                "PIO_FAULT_SEED": "3",
+            }
+        )
+        assert inj is faults.ACTIVE
+        with pytest.raises(TimeoutError):
+            inj.check("s")
+        assert faults.load_env_plan({}) is None
+
+    def test_disabled_costs_nothing(self):
+        faults.clear()
+        assert faults.ACTIVE is None  # the seams' whole fast path
+
+
+# ---------------------------------------------------------------------------
+# degraded marking
+
+
+class TestDegrade:
+    def test_scope_collects_and_counts(self):
+        from predictionio_tpu.obs.metrics import REGISTRY
+
+        counter = REGISTRY.get("pio_degraded_total").labels("unit_test")
+        before = counter.value
+        with degraded_scope() as reasons:
+            mark_degraded("unit_test")
+            mark_degraded("unit_test")  # deduped within scope
+            assert reasons == ["unit_test"]
+        assert counter.value == before + 2  # counter still counts both
+        # outside any scope: no crash, counter still moves
+        mark_degraded("unit_test")
+        assert counter.value == before + 3
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher resilience
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+class TestMicroBatcherShedding:
+    def test_bounded_queue_sheds(self):
+        reg = MetricsRegistry()
+        release = threading.Event()
+
+        def batch_fn(items):
+            release.wait(2)
+            return list(items)
+
+        async def run():
+            b = MicroBatcher(batch_fn, max_batch=1, max_queue=2, registry=reg)
+            first = asyncio.ensure_future(b.submit("w"))
+            await asyncio.sleep(0.05)  # wave 1 in flight, held
+            q1 = asyncio.ensure_future(b.submit(1))
+            q2 = asyncio.ensure_future(b.submit(2))
+            await asyncio.sleep(0.05)  # both queued (queue now full)
+            with pytest.raises(LoadShed) as ei:
+                await b.submit(3)
+            assert ei.value.retry_after_s > 0
+            release.set()
+            assert await first == "w"
+            assert await q1 == 1 and await q2 == 2
+            return b
+
+        _run(run())
+        assert reg.get("pio_shed_total").labels("queue").value == 1
+
+    def test_expired_items_resolve_before_dispatch(self):
+        reg = MetricsRegistry()
+        release = threading.Event()
+        dispatched: list[list] = []
+
+        def batch_fn(items):
+            if items == ["warm"]:
+                release.wait(2)
+                return ["warm-ok"]
+            dispatched.append(list(items))
+            return [i * 2 for i in items]
+
+        async def run():
+            b = MicroBatcher(batch_fn, max_batch=8, registry=reg)
+            warm = asyncio.ensure_future(b.submit("warm"))
+            await asyncio.sleep(0.05)  # wave 1 held: queue forms behind it
+            tok = deadline.set_deadline(0.01)  # 10 ms budget
+            doomed = asyncio.ensure_future(b.submit(7))
+            deadline.reset_deadline(tok)
+            healthy = asyncio.ensure_future(b.submit(5))
+            await asyncio.sleep(0.1)  # > doomed's budget, still queued
+            release.set()
+            assert await warm == "warm-ok"
+            with pytest.raises(DeadlineExceeded):
+                await doomed
+            assert await healthy == 10
+            return b
+
+        _run(run())
+        # the expired item never reached the device
+        assert dispatched == [[5]]
+        assert (
+            reg.get("pio_microbatch_deadline_expired_total").labels().value
+            == 1
+        )
+
+    def test_wave_binds_earliest_deadline_around_batch_fn(self):
+        seen: list[float | None] = []
+        release = threading.Event()
+
+        def batch_fn(items):
+            if items == ["warm"]:
+                release.wait(2)
+                return ["warm-ok"]
+            seen.append(deadline.remaining())
+            return list(items)
+
+        async def run():
+            b = MicroBatcher(batch_fn, max_batch=8, registry=MetricsRegistry())
+            warm = asyncio.ensure_future(b.submit("warm"))
+            await asyncio.sleep(0.05)
+            tok = deadline.set_deadline(30.0)
+            a = asyncio.ensure_future(b.submit("a"))
+            deadline.reset_deadline(tok)
+            c = asyncio.ensure_future(b.submit("c"))  # no deadline
+            await asyncio.sleep(0.05)
+            release.set()
+            await asyncio.gather(warm, a, c)
+
+        _run(run())
+        # batch_fn observed the wave's tightest budget (~30 s, not None)
+        assert len(seen) == 1 and seen[0] is not None and seen[0] < 30.0
+
+
+class TestMicroBatcherSoloRetry:
+    def test_poison_fails_alone_wave_mates_succeed(self):
+        reg = MetricsRegistry()
+        release = threading.Event()
+
+        def batch_fn(items):
+            if items == ["warm"]:
+                release.wait(2)
+                return ["warm-ok"]
+            if any(i == "poison" for i in items):
+                if len(items) > 1:
+                    raise RuntimeError("wave poisoned")
+                raise ValueError("poison alone")
+            return [i * 2 for i in items]
+
+        async def run():
+            b = MicroBatcher(batch_fn, max_batch=8, registry=reg)
+            warm = asyncio.ensure_future(b.submit("warm"))
+            await asyncio.sleep(0.05)
+            futs = [
+                asyncio.ensure_future(b.submit(x))
+                for x in [1, "poison", 3]
+            ]
+            await asyncio.sleep(0.05)  # all three coalesce into wave 2
+            release.set()
+            assert await warm == "warm-ok"
+            assert await futs[0] == 2
+            # the poison item fails with ITS OWN error, not the wave error
+            with pytest.raises(ValueError, match="poison alone"):
+                await futs[1]
+            assert await futs[2] == 6
+
+        _run(run())
+        assert reg.get("pio_microbatch_solo_retry_total").labels().value == 1
+
+    def test_solo_retry_disabled_fails_whole_wave(self):
+        release = threading.Event()
+
+        def batch_fn(items):
+            if items == ["warm"]:
+                release.wait(2)
+                return ["warm-ok"]
+            raise RuntimeError("wave boom")
+
+        async def run():
+            b = MicroBatcher(
+                batch_fn,
+                max_batch=8,
+                solo_retry=False,
+                registry=MetricsRegistry(),
+            )
+            warm = asyncio.ensure_future(b.submit("warm"))
+            await asyncio.sleep(0.05)
+            futs = [asyncio.ensure_future(b.submit(x)) for x in (1, 2)]
+            await asyncio.sleep(0.05)
+            release.set()
+            await warm
+            for f in futs:
+                with pytest.raises(RuntimeError, match="wave boom"):
+                    await f
+
+        _run(run())
+
+    def test_close_racing_solo_retry_stays_bounded(self):
+        """Satellite: close() arriving while a solo-retry pass is mid-item
+        must (a) not hang past the drain timeout, (b) resolve the remaining
+        un-retried futures with the wave error — nothing leaks."""
+        release_warm = threading.Event()
+        solo_started = threading.Event()
+        release_solo = threading.Event()
+
+        def batch_fn(items):
+            if items == ["warm"]:
+                release_warm.wait(2)
+                return ["warm-ok"]
+            if len(items) > 1:
+                raise RuntimeError("wave boom")
+            solo_started.set()
+            release_solo.wait(2)  # hold the FIRST solo item
+            return [items[0] * 10]
+
+        async def run():
+            b = MicroBatcher(
+                batch_fn,
+                max_batch=8,
+                drain_timeout_s=5.0,
+                registry=MetricsRegistry(),
+            )
+            warm = asyncio.ensure_future(b.submit("warm"))
+            await asyncio.sleep(0.05)
+            futs = [asyncio.ensure_future(b.submit(x)) for x in (1, 2, 3)]
+            await asyncio.sleep(0.05)
+            release_warm.set()  # wave [1,2,3] dispatches -> boom -> solo
+            await asyncio.get_running_loop().run_in_executor(
+                None, solo_started.wait, 2
+            )
+            # close() while solo item 1 is mid-flight
+            close_task = asyncio.get_running_loop().run_in_executor(
+                None, b.close
+            )
+            await asyncio.sleep(0.05)
+            t0 = time.perf_counter()
+            release_solo.set()
+            await close_task
+            closed_in = time.perf_counter() - t0
+            assert await warm == "warm-ok"
+            assert await futs[0] == 10  # in-flight solo item still lands
+            # remaining items: resolved with the wave error, not leaked
+            for f in futs[1:]:
+                with pytest.raises(RuntimeError, match="wave boom"):
+                    await f
+            return closed_in
+
+        closed_in = _run(run())
+        assert closed_in < 2.0  # condition wakeup, not drain timeout
+
+    def test_shutdown_resolves_expired_and_queued_items(self):
+        """Satellite: close() with a queue containing an already-expired
+        item resolves it with DeadlineExceeded (and the rest with the
+        shutdown error) — no future is leaked to hang a client."""
+        reg = MetricsRegistry()
+        release = threading.Event()
+
+        def batch_fn(items):
+            release.wait(2)
+            return list(items)
+
+        async def run():
+            b = MicroBatcher(batch_fn, max_batch=1, registry=reg)
+            warm = asyncio.ensure_future(b.submit("w"))
+            await asyncio.sleep(0.05)
+            tok = deadline.set_deadline(0.005)
+            expired_fut = asyncio.ensure_future(b.submit("late"))
+            deadline.reset_deadline(tok)
+            fresh_fut = asyncio.ensure_future(b.submit("fresh"))
+            await asyncio.sleep(0.05)  # "late" is now past its budget
+            close_task = asyncio.get_running_loop().run_in_executor(
+                None, b.close
+            )
+            await asyncio.sleep(0.05)
+            release.set()
+            await close_task
+            assert await warm == "w"
+            with pytest.raises(DeadlineExceeded):
+                await expired_fut
+            with pytest.raises(RuntimeError, match="closed"):
+                await fresh_fut
+
+        _run(run())
+        assert (
+            reg.get("pio_microbatch_deadline_expired_total").labels().value
+            == 1
+        )
+
+    def test_batch_fn_fault_seam(self):
+        faults.install(
+            [{"seam": "batch_fn", "kind": "error", "count": 1}]
+        )
+
+        async def run():
+            b = MicroBatcher(
+                lambda items: list(items), registry=MetricsRegistry()
+            )
+            with pytest.raises(faults.FaultInjected):
+                await b.submit(1)  # single-item wave: no solo pass
+            assert await b.submit(2) == 2  # plan exhausted: healthy again
+
+        _run(run())
+
+
+# ---------------------------------------------------------------------------
+# RemoteClient transport resilience (against a real daemon)
+
+
+@pytest.fixture()
+def daemon(tmp_path):
+    from predictionio_tpu.server.storage_server import StorageServer
+
+    s = StorageServer(tmp_path / "root", host="127.0.0.1", port=0)
+    s.start_background()
+    yield s
+    s.shutdown()
+
+
+def _client(url, **kw):
+    from predictionio_tpu.data.storage.remote_backend import RemoteClient
+
+    kw.setdefault("timeout", 2.0)
+    return RemoteClient(url, **kw)
+
+
+#: a loopback port nothing listens on (connect refused instantly)
+_DEAD_URL = "http://127.0.0.1:2"
+
+
+class TestRemoteClientResilience:
+    def test_send_phase_fault_is_retried(self, daemon):
+        inj = faults.install(
+            [
+                {
+                    "seam": "remote.send",
+                    "kind": "connection_reset",
+                    "count": 1,
+                }
+            ]
+        )
+        c = _client(f"http://127.0.0.1:{daemon.port}")
+        assert c.json("GET", "/v1/ping")["status"] == "alive"
+        assert inj.snapshot()[0]["fired"] == 1
+        assert c.breaker.state == "closed"
+
+    def test_response_phase_fault_retries_only_idempotent(self, daemon):
+        from predictionio_tpu.data.storage.remote_backend import (
+            RemoteStorageError,
+        )
+
+        c = _client(f"http://127.0.0.1:{daemon.port}")
+        faults.install(
+            [
+                {
+                    "seam": "remote.response",
+                    "kind": "connection_reset",
+                    "count": 1,
+                }
+            ]
+        )
+        # idempotent GET: replayed after the lost response
+        assert c.json("GET", "/v1/ping")["status"] == "alive"
+        # non-idempotent POST: fails loudly (the daemon may have committed)
+        faults.install(
+            [
+                {
+                    "seam": "remote.response",
+                    "kind": "connection_reset",
+                    "count": 1,
+                }
+            ]
+        )
+        with pytest.raises(RemoteStorageError, match="after send"):
+            c.request("POST", "/v1/apps", body=b"{}", idempotent=False)
+
+    def test_retry_policy_bounds_attempts(self):
+        from predictionio_tpu.data.storage.remote_backend import (
+            StorageUnavailable,
+        )
+
+        c = _client(
+            _DEAD_URL,
+            retry=RetryPolicy(max_attempts=3, base_backoff_s=0.001),
+            breaker=None,
+        )
+        inj = faults.install(
+            [{"seam": "remote.send", "kind": "connection_reset"}]
+        )
+        with pytest.raises(StorageUnavailable, match="unreachable"):
+            c.request("GET", "/v1/ping")
+        assert inj.snapshot()[0]["seen"] == 3  # exactly max_attempts
+
+    def test_breaker_opens_and_rejects_in_microseconds(self):
+        from predictionio_tpu.data.storage.remote_backend import (
+            StorageUnavailable,
+        )
+
+        c = _client(
+            _DEAD_URL,
+            retry=RetryPolicy(max_attempts=1),
+            breaker_threshold=2,
+            breaker_reset_s=60.0,
+        )
+        for _ in range(2):
+            with pytest.raises(StorageUnavailable):
+                c.request("GET", "/v1/ping")
+        assert c.breaker.state == "open"
+        t0 = time.perf_counter()
+        with pytest.raises(StorageUnavailable) as ei:
+            c.request("GET", "/v1/ping")
+        assert time.perf_counter() - t0 < 0.05  # no connect attempt at all
+        assert ei.value.retry_after_s > 0
+        assert breaker_states()["storage:127.0.0.1:2"]["state"] == "open"
+
+    def test_breaker_half_open_recovers_against_live_daemon(
+        self, daemon, monkeypatch
+    ):
+        c = _client(f"http://127.0.0.1:{daemon.port}", breaker_threshold=1)
+        # force it open without touching the network
+        c.breaker.record_failure()
+        assert c.breaker.state == "open"
+        # frozen-clock jump past the reset window
+        real_now = breaker_mod._now
+        monkeypatch.setattr(
+            breaker_mod, "_now", lambda: real_now() + 3600.0
+        )
+        assert c.breaker.state == "half_open"
+        assert c.json("GET", "/v1/ping")["status"] == "alive"  # the trial
+        assert c.breaker.state == "closed"
+
+    def test_deadline_mid_trial_does_not_wedge_breaker(self, daemon, monkeypatch):
+        """Review regression: DeadlineExceeded during the half-open trial
+        releases the trial slot, so the NEXT call still gets a trial and
+        can close the breaker against the healthy daemon."""
+        c = _client(f"http://127.0.0.1:{daemon.port}", breaker_threshold=1)
+        c.breaker.record_failure()
+        real_now = breaker_mod._now
+        monkeypatch.setattr(breaker_mod, "_now", lambda: real_now() + 3600.0)
+        assert c.breaker.state == "half_open"
+        # trial #1: admitted (budget alive at the guard), then the injected
+        # latency burns the budget and the injected timeout surfaces as a
+        # net error — with the budget gone that reports DeadlineExceeded,
+        # abandoning the trial
+        faults.install(
+            [
+                {
+                    "seam": "remote.send",
+                    "kind": "latency",
+                    "latency_s": 0.05,
+                    "count": 1,
+                },
+                {"seam": "remote.send", "kind": "timeout", "count": 1},
+            ]
+        )
+        with deadline_scope(budget_s=0.02):
+            with pytest.raises(DeadlineExceeded):
+                c.request("GET", "/v1/ping")
+        # trial #2 must still be admitted — and closes the breaker
+        assert c.json("GET", "/v1/ping")["status"] == "alive"
+        assert c.breaker.state == "closed"
+
+    def test_deadline_preempts_call(self, daemon):
+        c = _client(f"http://127.0.0.1:{daemon.port}")
+        with deadline_scope(budget_s=-0.01):
+            with pytest.raises(DeadlineExceeded):
+                c.request("GET", "/v1/ping")
+        # with budget to spare the call proceeds (timeout capped, not cut)
+        with deadline_scope(budget_s=5.0):
+            assert c.json("GET", "/v1/ping")["status"] == "alive"
+
+    def test_deadline_capped_timeout_beats_hung_daemon(self):
+        """The headline stall-killer: a daemon that ACCEPTS connections but
+        never answers (the worst case — connect-refused is instant, a hang
+        is 30 s) is abandoned when the request budget runs out, not when
+        the client's 30 s transport timeout fires."""
+        import socket
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)  # connects land in the backlog; nothing ever answers
+        try:
+            c = _client(
+                f"http://127.0.0.1:{srv.getsockname()[1]}", timeout=30.0
+            )
+            t0 = time.perf_counter()
+            with deadline_scope(budget_s=0.2):
+                with pytest.raises(DeadlineExceeded):
+                    c.request("GET", "/v1/ping")
+            # the 30 s transport timeout did NOT apply: the deadline did
+            assert time.perf_counter() - t0 < 2.0
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# event server: ingest answers 503 + Retry-After when the store is down
+
+
+class TestEventServerShedsWhenStoreDown:
+    @pytest.fixture()
+    def split_storage(self, tmp_path):
+        """Metadata in local sqlite (auth works), EVENTDATA behind a dead
+        remote daemon (inserts fail) with a hair-trigger breaker."""
+        from predictionio_tpu.data.storage.config import (
+            StorageConfig,
+            StorageRuntime,
+        )
+
+        cfg = StorageConfig.from_env(
+            {
+                "PIO_HOME": str(tmp_path / "home"),
+                "PIO_STORAGE_SOURCES_DEADR_TYPE": "remote",
+                "PIO_STORAGE_SOURCES_DEADR_URL": _DEAD_URL,
+                "PIO_STORAGE_SOURCES_DEADR_TIMEOUT": "0.3",
+                "PIO_STORAGE_SOURCES_DEADR_RETRIES": "1",
+                "PIO_STORAGE_SOURCES_DEADR_BREAKER_THRESHOLD": "1",
+                "PIO_STORAGE_SOURCES_DEADR_BREAKER_RESET_S": "30",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DEADR",
+            }
+        )
+        rt = StorageRuntime(cfg)
+        yield rt
+        rt.close()
+
+    def _app_and_key(self, rt):
+        from predictionio_tpu.data.storage.base import AccessKey, App
+        from predictionio_tpu.obs.quality import QualityMonitor
+        from predictionio_tpu.server.event_server import (
+            create_event_server_app,
+        )
+
+        # straight through the metadata DAOs: app_new would also init the
+        # (deliberately dead) event store
+        app_id = rt.apps().insert(App(id=0, name="shed", description=None))
+        rt.access_keys().insert(
+            AccessKey(key="k-shed", appid=app_id, events=())
+        )
+        reg = MetricsRegistry()
+        app = create_event_server_app(
+            rt, registry=reg, quality=QualityMonitor(registry=reg)
+        )
+        return app, "k-shed"
+
+    def test_post_event_503_with_retry_after(self, split_storage):
+        import json as _json
+
+        from predictionio_tpu.server.httpd import Request
+
+        app, key = self._app_and_key(split_storage)
+        body = _json.dumps(
+            {"event": "rate", "entityType": "user", "entityId": "u1"}
+        ).encode()
+        r = app.handle(
+            Request("POST", "/events.json", {"accessKey": key}, {}, body)
+        )
+        assert r.status == 503, r.body
+        assert "Retry-After" in r.headers
+        assert "unavailable" in r.body["message"]
+        # breaker is now open: the next ingest sheds in ~0 ms with the
+        # breaker's reset hint riding the Retry-After header
+        t0 = time.perf_counter()
+        r2 = app.handle(
+            Request("POST", "/events.json", {"accessKey": key}, {}, body)
+        )
+        assert time.perf_counter() - t0 < 0.05
+        assert r2.status == 503
+        assert int(r2.headers["Retry-After"]) >= 1
+
+    def test_batch_marks_items_503_not_500(self, split_storage):
+        import json as _json
+
+        from predictionio_tpu.server.httpd import Request
+
+        app, key = self._app_and_key(split_storage)
+        body = _json.dumps(
+            [
+                {"event": "rate", "entityType": "user", "entityId": "u1"},
+                {"entityType": "user"},  # invalid: still a per-item 400
+            ]
+        ).encode()
+        r = app.handle(
+            Request(
+                "POST", "/batch/events.json", {"accessKey": key}, {}, body
+            )
+        )
+        assert r.status == 200  # per-item status contract preserved
+        assert [item["status"] for item in r.body] == [503, 400]
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM -> SIGKILL escalation
+
+
+class TestStopEscalation:
+    def _spawn(self, tmp_path, ignore_term: bool):
+        ready = tmp_path / "ready"
+        code = (
+            "import signal, sys, time\n"
+            + (
+                "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+                if ignore_term
+                else ""
+            )
+            + "open(sys.argv[2], 'w').write('up')\n"
+            + "time.sleep(60)\n"
+        )
+        # argv carries 'predictionio_tpu' so pid_alive's /proc cmdline
+        # ownership check recognizes the process as ours
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code, "predictionio_tpu-stoptest", str(ready)]
+        )
+        deadline_t = time.monotonic() + 10
+        while not ready.exists() and time.monotonic() < deadline_t:
+            time.sleep(0.02)
+        assert ready.exists(), "child never came up"
+        pidfile = tmp_path / "victim.pid"
+        pidfile.write_text(str(proc.pid))
+        return proc, pidfile
+
+    def test_sigterm_wins_for_cooperative_daemon(self, tmp_path):
+        from predictionio_tpu.tools import daemon as d
+
+        proc, pidfile = self._spawn(tmp_path, ignore_term=False)
+        try:
+            assert d.stop_pidfile(pidfile, timeout=5.0) == "TERM"
+            assert not pidfile.exists()
+        finally:
+            proc.wait(timeout=5)
+
+    def test_sigkill_escalation_for_wedged_daemon(self, tmp_path):
+        from predictionio_tpu.tools import daemon as d
+
+        proc, pidfile = self._spawn(tmp_path, ignore_term=True)
+        try:
+            assert d.stop_pidfile(pidfile, timeout=0.3) == "KILL"
+            assert not pidfile.exists()
+        finally:
+            proc.wait(timeout=5)
+        assert proc.returncode == -9  # SIGKILL actually won
+
+    def test_nothing_running_reports_none(self, tmp_path):
+        from predictionio_tpu.tools import daemon as d
+
+        pidfile = tmp_path / "ghost.pid"
+        pidfile.write_text("999999999")
+        assert d.stop_pidfile(pidfile) is None
+        assert not pidfile.exists()
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+
+
+class TestCLISurface:
+    def test_stop_verb_and_deploy_flags_registered(self):
+        from predictionio_tpu.tools.cli import build_parser
+
+        p = build_parser()
+        args = p.parse_args(["stop", "eventserver", "--timeout", "3"])
+        assert args.fn.__name__ == "do_stop" and args.timeout == 3.0
+        args = p.parse_args(
+            [
+                "deploy",
+                "--engine", "x",
+                "--deadline-s", "0.5",
+                "--max-inflight", "64",
+                "--max-queue", "128",
+            ]
+        )
+        assert args.deadline_s == 0.5
+        assert args.max_inflight == 64 and args.max_queue == 128
+        args = p.parse_args(["undeploy", "--pidfile", "/tmp/x.pid"])
+        assert args.pidfile == "/tmp/x.pid"
+
+    def test_pio_stop_reports_signal(self, tmp_path, monkeypatch, capsys):
+        from predictionio_tpu.tools.cli import main as cli_main
+
+        monkeypatch.setenv("PIO_HOME", str(tmp_path))
+        assert cli_main(["stop", "nosuchdaemon"]) == 1
+        pids = tmp_path / "pids"
+        pids.mkdir(parents=True)
+        (pids / "ghost.pid").write_text("999999999")
+        assert cli_main(["stop", "ghost"]) == 0
+        out = capsys.readouterr().out
+        assert "was not running" in out
+
+    def test_pio_stop_never_unlinks_stray_files(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        """Review regression: a bare daemon name must map ONLY to
+        $PIO_HOME/pids/<name>.pid — a file (or directory) named
+        `eventserver` in the cwd must not be read or deleted."""
+        from predictionio_tpu.tools.cli import main as cli_main
+
+        monkeypatch.setenv("PIO_HOME", str(tmp_path / "home"))
+        monkeypatch.chdir(tmp_path)
+        stray = tmp_path / "eventserver"
+        stray.write_text("precious user data")
+        assert cli_main(["stop", "eventserver"]) == 1  # no pidfile
+        assert stray.read_text() == "precious user data"
+        straydir = tmp_path / "dashboard"
+        straydir.mkdir()
+        assert cli_main(["stop", "dashboard"]) == 1  # no crash either
+        capsys.readouterr()
