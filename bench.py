@@ -862,40 +862,34 @@ def main() -> None:
 
         # Roofline accounting for the pallas train path (single-device
         # TPU): HBM bytes and MXU flops per iteration from the actual
-        # staged plan, vs v5e peaks (819 GB/s HBM, ~197 bf16 TFLOP/s MXU),
-        # so "where the time goes" is a measured claim, not a vibe.
+        # staged plan vs the platform peak table, so "where the time goes"
+        # is a measured claim, not a vibe.  The arithmetic lives in
+        # obs/device.py (als_plan_roofline) — the serving process reports
+        # the same numbers live at /efficiency.json.
+        from predictionio_tpu.obs.device import (
+            als_plan_roofline,
+            device_peaks,
+            utilization_frac,
+        )
         from predictionio_tpu.ops.als import LAST_PLAN_INFO
 
-        if on_tpu and LAST_PLAN_INFO:
+        per_iter = als_plan_roofline(LAST_PLAN_INFO) if on_tpu else None
+        if per_iter is not None:
             pi = LAST_PLAN_INFO
-            width = pi["width"]
-            passes = {"hilo": 2, "bf16": 1, "highest": 6}[pi["precision"]]
-            row_b = width * 4
-            k_pad = (pi["rank"] + 7) // 8 * 8  # sublane round-up
-            gb = 0.0
-            fl = 0.0
-            for side in ("user", "item"):
-                rows = pi[f"rows_{side}"]
-                if pi.get("mode") == "fused":
-                    # transposed gather write+read of cv_t [nt, k_pad, T]
-                    # + wrv [nt, 8, T] read + seg3 + one output write per
-                    # block (VMEM-carried: no accumulator re-reads)
-                    gb += rows * (2 * k_pad * 4 + 8 * 4 + 4) / 1e9
-                    gb += pi[f"blocks_{side}"] * 128 * row_b / 1e9
-                else:
-                    # gather factors + write flat rows + kernel read
-                    gb += rows * (512 + 2 * row_b) / 1e9
-                    # per-chunk accumulator read-modify-write
-                    gb += (
-                        pi[f"chunks_{side}"] * pi[f"blocks_{side}"] * 128
-                        * row_b * 3
-                    ) / 1e9
-                fl += 2.0 * rows * 128 * width * passes / 1e12
+            gb = per_iter["gb_per_iter"]
+            fl = per_iter["tflop_eq_per_iter"]
+            peaks = device_peaks()
             it_s = C.train_s / C.params.num_iterations
             metrics["roofline_gb_per_iter"] = round(gb, 2)
             metrics["roofline_achieved_gb_s"] = round(gb / it_s, 1)
             metrics["roofline_tflop_eq_per_iter"] = round(fl, 3)
             metrics["roofline_achieved_tflop_s"] = round(fl / it_s, 2)
+            metrics["roofline_hbm_utilization_frac"] = round(
+                utilization_frac(gb / it_s, peaks.hbm_gbps), 4
+            )
+            metrics["roofline_mxu_utilization_frac"] = round(
+                utilization_frac(fl / it_s, peaks.tflops), 4
+            )
             metrics["als_pallas_mode"] = pi.get("mode", "?")
             if "stage_s" in pi:
                 # host staging share of the cold number (sort + block-pad
@@ -903,9 +897,10 @@ def main() -> None:
                 metrics["als_stage_s"] = pi["stage_s"]
             log(
                 f"# roofline/iter: ~{gb:.1f} GB moved -> {gb / it_s:.0f} GB/s "
-                f"achieved (HBM peak ~819); one-hot MXU {fl:.2f} TFLOP(eq) "
-                f"-> {fl / it_s:.1f} TFLOP/s (bf16 peak ~197); "
-                f"iter={it_s * 1000:.0f} ms; mode={pi.get('mode')}"
+                f"achieved (HBM peak ~{peaks.hbm_gbps:.0f}); one-hot MXU "
+                f"{fl:.2f} TFLOP(eq) -> {fl / it_s:.1f} TFLOP/s (peak "
+                f"~{peaks.tflops:.0f}); iter={it_s * 1000:.0f} ms; "
+                f"mode={pi.get('mode')}"
             )
 
     def sec_als_rank32():
@@ -1144,6 +1139,31 @@ def main() -> None:
         device_sync(outs[-1][0])
         ncf_wave32_ms = (time.perf_counter() - t0) / 50 * 1000
         metrics["ncf_wave32_pipelined_ms"] = round(ncf_wave32_ms, 3)
+        # serving-section utilization: XLA's own cost model for the wave
+        # program vs the per-wave wall clock — how much of the chip one
+        # 32-query wave actually uses (the headroom ROADMAP item 3 spends)
+        from predictionio_tpu.obs.device import (
+            device_peaks,
+            jit_cost_analysis,
+            utilization_frac,
+        )
+
+        cost = jit_cost_analysis(
+            _score_topk_batch, ncf_state.params, waves[0], num_items, K
+        )
+        if cost is not None:
+            peaks = device_peaks()
+            wave_s = ncf_wave32_ms / 1000.0
+            gbps = cost["bytes"] / wave_s / 1e9
+            tflops = cost["flops"] / wave_s / 1e12
+            metrics["ncf_wave32_achieved_gb_s"] = round(gbps, 2)
+            metrics["ncf_wave32_achieved_tflop_s"] = round(tflops, 4)
+            metrics["ncf_wave32_hbm_utilization_frac"] = round(
+                utilization_frac(gbps, peaks.hbm_gbps), 4
+            )
+            metrics["ncf_wave32_mxu_utilization_frac"] = round(
+                utilization_frac(tflops, peaks.tflops), 4
+            )
         log(
             f"# ncf serving: solo wall p50={ncf_p50:.1f}ms of which tunnel "
             f"RTT p50={rtt_ms:.1f}ms; solo DEVICE cost={ncf_dev_ms:.2f}"
@@ -1193,8 +1213,13 @@ def main() -> None:
             failed.append("als_serving")
             log("# SECTION als_serving SKIPPED: no trained ALS state")
 
+    from predictionio_tpu.obs.device import BENCH_SCHEMA_VERSION
+
     train_s = getattr(C, "train_s", None)
     out = {
+        # schema_version gates `pio bench --compare`: version-less lines
+        # predate the regression gate and are refused (exit 2)
+        "schema_version": BENCH_SCHEMA_VERSION,
         "metric": "als_ml20m_train_time"
         if scale == 1.0
         else f"als_ml20m_train_time_scale{scale:g}",

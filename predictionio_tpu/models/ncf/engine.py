@@ -12,6 +12,7 @@ Query/result shapes match the recommendation template ({user, num} ->
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from functools import partial
 from typing import Any
@@ -21,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from predictionio_tpu.core.base import Algorithm, EngineContext, SanityCheckError
+from predictionio_tpu.obs import device as device_obs
 from predictionio_tpu.core.engine import Engine, engine_factory
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.models.recommendation.engine import (
@@ -301,22 +303,62 @@ class NCFAlgorithm(Algorithm):
         if not iq:
             return []
         n_items = _packable_n_items(model)
-        uidx = np.array(
-            [model.user_vocab.get(q.user, -1) for _, q in iq], np.int32
-        )
-        # round BOTH static shapes up to powers of two (b >= 32, k >= 16):
-        # a novel client `num` or odd wave size must never trigger a fresh
-        # XLA compile mid-serving — results are sliced per query below
-        want_k = min(max(q.num for _, q in iq), n_items)
-        k = min(max(1 << (want_k - 1).bit_length(), 16), n_items)
-        b = max(1 << (len(iq) - 1).bit_length(), 32)
-        padded = np.zeros(b, np.int32)
-        padded[: len(iq)] = np.maximum(uidx, 0)
-        packed = np.asarray(
-            _score_topk_batch(
-                model.state.params, jnp.asarray(padded), n_items, k
+        with device_obs.wave_stage("host_gather"):
+            uidx = np.array(
+                [model.user_vocab.get(q.user, -1) for _, q in iq], np.int32
             )
+            # round BOTH static shapes up to powers of two (b >= 32,
+            # k >= 16): a novel client `num` or odd wave size must never
+            # trigger a fresh XLA compile mid-serving — results are sliced
+            # per query below
+            want_k = min(max(q.num for _, q in iq), n_items)
+            k = min(max(1 << (want_k - 1).bit_length(), 16), n_items)
+            b = max(1 << (len(iq) - 1).bit_length(), 32)
+            padded = np.zeros(b, np.int32)
+            padded[: len(iq)] = np.maximum(uidx, 0)
+        # shapes past the padding menu still compile (a client sweeping
+        # `num` walks k through every power of two): account every
+        # signature so churn shows up as a recompile storm, not a mystery.
+        # The table shape is part of the key — two deployed models must not
+        # share cost/compile entries.
+        eff = device_obs.default_efficiency()
+        sig = (b, k, n_items) + tuple(
+            model.state.params["user_emb"].shape
         )
+        device_obs.default_recompiles().note_signature(
+            "ncf.batch_predict", sig
+        )
+        with device_obs.wave_stage("h2d"):
+            users_dev = jnp.asarray(padded)
+            device_obs.note_transfer("h2d", padded.nbytes)
+        # deferred: the AOT cost-analysis compile runs on a daemon thread,
+        # concurrent with the jit cache's own compile of this signature —
+        # never inside the wave's deadline
+        eff.capture_cost(
+            "ncf.batch_predict",
+            _score_topk_batch,
+            model.state.params,
+            users_dev,
+            n_items,
+            k,
+            signature=sig,
+            defer=True,
+        )
+        t_dev = time.perf_counter()
+        with device_obs.wave_stage("compute"):
+            packed_dev = _score_topk_batch(
+                model.state.params, users_dev, n_items, k
+            )
+            packed_dev.block_until_ready()
+        compute_s = time.perf_counter() - t_dev
+        device_obs.note_wave_device(device_obs.device_label(packed_dev))
+        device_obs.note_wave_cost(
+            "ncf.batch_predict", eff.cached_cost("ncf.batch_predict", sig)
+        )
+        with device_obs.wave_stage("d2h"):
+            packed = np.asarray(packed_dev)
+            device_obs.note_transfer("d2h", packed.nbytes)
+        eff.observe("ncf.batch_predict", compute_s, signature=sig)
         top_s = packed[0]
         top_i = packed[1].astype(np.int64)
         out = []

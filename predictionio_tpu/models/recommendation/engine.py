@@ -12,7 +12,9 @@ lambda=0.01/seed=3 mirror the template's engine.json.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Any
 
 import jax
@@ -31,6 +33,7 @@ from predictionio_tpu.core import (
 )
 from predictionio_tpu.core.engine import engine_factory
 from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.obs import device as device_obs
 from predictionio_tpu.ops.als import ALSParams, ALSState, train_als
 from predictionio_tpu.ops.topk import host_topk, host_topk_batch
 
@@ -327,13 +330,49 @@ class ALSAlgorithm(Algorithm):
             uidx = np.asarray([u for _, u, _ in rows], np.int32)
             k = max(min(q.num, len(model.item_vocab)) for _, _, q in rows)
             if len(rows) >= self.DEVICE_BATCH_MIN:
-                U = jnp.asarray(model.user_factors)[uidx]
-                scores = U @ jnp.asarray(model.item_factors).T  # [B, n_items]
-                top_s, top_i = jax.lax.top_k(scores, k)
-                top_s, top_i = np.asarray(top_s), np.asarray(top_i)
+                eff = device_obs.default_efficiency()
+                with device_obs.wave_stage("h2d"):
+                    # count the bytes that actually cross: numpy factors
+                    # (a freshly persisted model) upload whole matrices,
+                    # device-resident factors upload nothing
+                    uploaded = uidx.nbytes + sum(
+                        a.nbytes
+                        for a in (model.user_factors, model.item_factors)
+                        if not hasattr(a, "devices")
+                    )
+                    U = jnp.asarray(model.user_factors)
+                    V = jnp.asarray(model.item_factors)
+                    uidx_dev = jnp.asarray(uidx)
+                    device_obs.note_transfer("h2d", uploaded)
+                # factor shapes are part of the key — two deployed models
+                # (different rank / vocab) must not share cost entries
+                sig = (len(rows), k) + tuple(U.shape) + tuple(V.shape)
+                device_obs.default_recompiles().note_signature(
+                    "als.batch_topk", sig
+                )
+                eff.capture_cost(
+                    "als.batch_topk", _device_score_topk, U, V, uidx_dev, k,
+                    signature=sig, defer=True,
+                )
+                t_dev = time.perf_counter()
+                with device_obs.wave_stage("compute"):
+                    top_s, top_i = _device_score_topk(U, V, uidx_dev, k)
+                    top_s.block_until_ready()
+                compute_s = time.perf_counter() - t_dev
+                device_obs.note_wave_device(device_obs.device_label(top_s))
+                device_obs.note_wave_cost(
+                    "als.batch_topk", eff.cached_cost("als.batch_topk", sig)
+                )
+                with device_obs.wave_stage("d2h"):
+                    top_s, top_i = np.asarray(top_s), np.asarray(top_i)
+                    device_obs.note_transfer(
+                        "d2h", top_s.nbytes + top_i.nbytes
+                    )
+                eff.observe("als.batch_topk", compute_s, signature=sig)
             else:
-                Uh, Vh = model.host_factors()
-                top_s, top_i = host_topk_batch(Uh[uidx] @ Vh.T, k)
+                with device_obs.wave_stage("host_gather"):
+                    Uh, Vh = model.host_factors()
+                    top_s, top_i = host_topk_batch(Uh[uidx] @ Vh.T, k)
             for row, (i, _, q) in enumerate(rows):
                 n = min(q.num, len(model.item_vocab))
                 out.append(
@@ -368,6 +407,16 @@ class ALSAlgorithm(Algorithm):
             user_vocab=BiMap.from_state(data["user_vocab"]),
             item_vocab=BiMap.from_state(data["item_vocab"]),
         )
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _device_score_topk(U, V, uidx, k: int):
+    """The serving top-k as ONE compiled program ([B, rank] gather +
+    [B, rank] x [rank, n_items] matmul + top-k) instead of three eager
+    dispatches — and a jit entry point the device-efficiency layer can run
+    ``cost_analysis()`` against (obs/device.py)."""
+    scores = U[uidx] @ V.T  # [B, n_items]
+    return jax.lax.top_k(scores, k)
 
 
 class RecommendationServing(FirstServing):

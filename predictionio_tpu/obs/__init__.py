@@ -7,11 +7,26 @@ ring (logging.py), a flight recorder for the slowest/errored requests
 (flight.py), rolling-window SLO tracking with burn rates + health routes
 (slo.py), on-demand jax.profiler capture (profiler.py), online model-quality
 monitoring — prediction log, feedback joins, drift detection (quality.py) —
-HTTP exposition for all of it (http.py), and a sniffer plugin proving the
+device-efficiency attribution — XLA cost/roofline capture, recompile-storm
+detection, wave-timeline splits, the bench perf-regression gate (device.py)
+— HTTP exposition for all of it (http.py), and a sniffer plugin proving the
 plugin seams can consume the registry (plugin.py).  Dependency-free; the
 process-global default registry is ``REGISTRY``.
 """
 
+from predictionio_tpu.obs.device import (
+    DEVICE_EFFICIENCY,
+    RECOMPILES,
+    DevicePeaks,
+    EfficiencyTracker,
+    RecompileTracker,
+    compare_bench,
+    device_peaks,
+    device_snapshot,
+    jit_cost_analysis,
+    wave_stage,
+    wave_timeline,
+)
 from predictionio_tpu.obs.flight import FLIGHT, FlightRecorder, annotate
 from predictionio_tpu.obs.logging import (
     REQUEST_ID_HEADER,
@@ -57,6 +72,9 @@ from predictionio_tpu.obs.tracing import (
 )
 
 __all__ = [
+    "DEVICE_EFFICIENCY",
+    "DevicePeaks",
+    "EfficiencyTracker",
     "FLIGHT",
     "FlightRecorder",
     "JsonLineFormatter",
@@ -77,13 +95,19 @@ __all__ = [
     "MetricsHistory",
     "MetricsRegistry",
     "QualityMonitor",
+    "RECOMPILES",
+    "RecompileTracker",
     "Span",
     "annotate",
     "clear_traces",
+    "compare_bench",
     "configure_logging",
     "current_span",
     "default_quality",
     "default_registry",
+    "device_peaks",
+    "device_snapshot",
+    "jit_cost_analysis",
     "get_log_ring",
     "get_request_id",
     "install_jax_compile_listener",
@@ -95,4 +119,6 @@ __all__ = [
     "sample_runtime_gauges",
     "set_request_context",
     "trace",
+    "wave_stage",
+    "wave_timeline",
 ]

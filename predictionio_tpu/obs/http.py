@@ -15,6 +15,9 @@ onto a server:
   GET  /quality.json        online model quality: per-variant metrics +
                             drift state (servers constructed with a
                             QualityMonitor)
+  GET  /efficiency.json     device efficiency: achieved-vs-peak roofline per
+                            jitted entry point, recompile accounting (and
+                            any active recompile storm), transfer tallies
   GET  /healthz             liveness — ALWAYS ungated (load balancers carry
                             no keys); advisory SLO status rides along
   GET  /readyz              readiness checks (model loaded, stores up, ...)
@@ -37,6 +40,7 @@ from __future__ import annotations
 import json
 from typing import Any, Callable, Mapping
 
+from predictionio_tpu.obs.device import device_snapshot
 from predictionio_tpu.obs.flight import FlightRecorder, current_annotations
 from predictionio_tpu.obs.logging import get_log_ring
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
@@ -60,6 +64,7 @@ _OBS_PATHS = frozenset(
         "/traces.json",
         "/logs.json",
         "/quality.json",
+        "/efficiency.json",
         "/healthz",
         "/readyz",
         "/slo.json",
@@ -246,6 +251,14 @@ def add_observability_routes(
         @route("GET", "/quality\\.json")
         def quality_json(req: Request) -> Response:
             return json_response(200, app.quality.snapshot())
+
+    # -- device efficiency ---------------------------------------------------
+    # debug-gated like the flight recorder: per-fn cost tables and storm
+    # state describe the serving program, not the request — the event
+    # server's anonymous ingest port must not leak them
+    @route("GET", "/efficiency\\.json")
+    def efficiency_json(req: Request) -> Response:
+        return json_response(200, device_snapshot())
 
     # -- flight recorder -----------------------------------------------------
     @route("GET", "/debug/flight\\.json")

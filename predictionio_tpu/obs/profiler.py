@@ -21,8 +21,10 @@ import sys
 import tempfile
 import threading
 import time
+import weakref
 from typing import Any
 
+from predictionio_tpu.obs import device as device_obs
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
 
 #: upper bound on one capture; profiles are for debugging, not surveillance
@@ -129,11 +131,22 @@ class ProfilerController:
 #: the process-wide controller — jax tracing is global, so one per process
 PROFILER = ProfilerController()
 
+#: last-seen pjit-cache size per registry, so a scrape can turn the size
+#: gauge into a growth COUNTER (cache growth == fresh XLA compiles — the
+#: scrape-level recompile signal that needs no call-site attribution)
+_cache_size_seen: "weakref.WeakKeyDictionary[MetricsRegistry, int]" = (
+    weakref.WeakKeyDictionary()
+)
+
 
 def sample_runtime_gauges(registry: MetricsRegistry | None = None) -> bool:
     """Refresh JAX runtime gauges: live device buffers (count + bytes),
     per-device memory stats where the backend reports them (TPU does, CPU
-    returns None), and jit/pjit executable-cache entries.  Every probe is
+    returns None), jit/pjit executable-cache entries PLUS their growth
+    since the last scrape (``pio_jax_compile_cache_growth_total`` — cache
+    growth is compiles happening), and the process-cumulative host<->device
+    transfer tallies the device-efficiency layer keeps
+    (``pio_device_transfer_bytes{direction}``).  Every probe is
     individually fenced — telemetry must never break a scrape — and the
     whole call is a no-op returning False unless jax is ALREADY imported in
     this process: a scrape of the admin/dashboard/event/storage daemons
@@ -184,6 +197,23 @@ def sample_runtime_gauges(registry: MetricsRegistry | None = None) -> bool:
             "pio_jax_pjit_cache_entries",
             "Compiled executables held by the pjit caches",
         ).set(size)
+        last = _cache_size_seen.get(reg)
+        if last is not None and size > last:
+            reg.counter(
+                "pio_jax_compile_cache_growth_total",
+                "pjit-cache entries added between scrapes (fresh compiles)",
+            ).inc(size - last)
+        _cache_size_seen[reg] = size
+    except Exception:
+        pass
+    try:
+        fam = reg.gauge(
+            "pio_device_transfer_bytes",
+            "Process-cumulative host<->device transfer bytes by direction",
+            labelnames=("direction",),
+        )
+        for direction, total in device_obs.transfer_totals().items():
+            fam.labels(direction).set(total)
     except Exception:
         pass
     return True

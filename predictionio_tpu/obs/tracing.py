@@ -175,8 +175,11 @@ def install_jax_compile_listener() -> bool:
     Registers a ``jax.monitoring`` duration listener that records
     ``/jax/core/compile``-family events into ``pio_jax_compile_seconds`` —
     this is how a training run's stage breakdown separates XLA compile time
-    from execute time.  Idempotent; returns False when the monitoring API is
-    unavailable (the listener is additive-only, so failure is harmless).
+    from execute time — and counts them into ``pio_jax_compile_total`` so
+    the device-efficiency layer (obs/device.py) can report cumulative
+    compile activity next to its per-(fn, shapes) recompile attribution.
+    Idempotent; returns False when the monitoring API is unavailable (the
+    listener is additive-only, so failure is harmless).
     """
     global _jax_listener_installed
     with _jax_listener_lock:
@@ -199,6 +202,11 @@ def install_jax_compile_listener() -> bool:
                     labelnames=("event",),
                     buckets=STAGE_BUCKETS,
                 ).labels(event).observe(duration)
+                REGISTRY.counter(
+                    "pio_jax_compile_total",
+                    "XLA compile events by jax monitoring event name",
+                    labelnames=("event",),
+                ).labels(event).inc()
             except Exception:
                 pass  # telemetry must never break compilation
 

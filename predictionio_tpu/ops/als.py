@@ -609,6 +609,9 @@ def _train_pallas_mode(user_idx, item_idx, rating, num_users, num_items,
         (tiles_u, up.n_blocks, tiles_i, ip.n_blocks),
         p, num_users_pad, num_items_pad, fused, single_step=per_iter,
     )
+    import time as _time
+
+    t0 = _time.perf_counter()
     if per_iter:
         for _ in range(p.num_iterations):
             U, V = steps(u_plan, u_oth, u_rat, u_val,
@@ -618,7 +621,41 @@ def _train_pallas_mode(user_idx, item_idx, rating, num_users, num_items,
                      i_plan, i_oth, i_rat, i_val, U, V,
                      jnp.int32(p.num_iterations))
     jax.block_until_ready((U, V))
+    wall_s = _time.perf_counter() - t0
+    _record_pallas_efficiency(wall_s, p)
     return ALSState(user_factors=U[:num_users], item_factors=V[:num_items])
+
+
+def _record_pallas_efficiency(wall_s: float, p: ALSParams) -> None:
+    """Place the pallas train on the live roofline: the kernel body is
+    opaque to XLA's ``cost_analysis``, so the per-iteration HBM/MXU cost
+    comes from the staged plan's analytic arithmetic
+    (``obs.device.als_plan_roofline`` — the same math bench.py reports) and
+    joins the measured dispatch wall clock."""
+    from predictionio_tpu.obs import device as device_obs
+
+    per_iter_cost = device_obs.als_plan_roofline(LAST_PLAN_INFO)
+    if per_iter_cost is None:
+        return
+    sig = (
+        LAST_PLAN_INFO.get("mode"),
+        LAST_PLAN_INFO.get("rows_user"),
+        LAST_PLAN_INFO.get("rows_item"),
+        p.rank,
+    )
+    eff = device_obs.default_efficiency()
+    eff.record_cost(
+        "als.pallas_step",
+        flops=per_iter_cost["tflop_eq_per_iter"] * 1e12,
+        nbytes=per_iter_cost["gb_per_iter"] * 1e9,
+        signature=sig,
+        source="plan",
+    )
+    eff.observe(
+        "als.pallas_step",
+        wall_s / max(p.num_iterations, 1),
+        signature=sig,
+    )
 
 
 def _make_train_step(mesh: Mesh | None, num_users_pad, num_items_pad, p: ALSParams):
@@ -783,8 +820,41 @@ def train_als(
         V0 = jax.device_put(V0, repl_sh)
 
     step = _make_train_step(mesh, num_users_pad, num_items_pad, p)
+    import time as _time
+
+    from predictionio_tpu.obs import device as device_obs
+    from predictionio_tpu.parallel.mesh import meter_shards
+
+    # the solve step on the roofline: XLA's own per-iteration cost joined
+    # with the measured wall clock.  The capture is deferred BEFORE the
+    # loop so its out-of-band analysis compile runs concurrently with the
+    # training dispatches instead of adding a second synchronous compile
+    # to the cold-train wall time bench's regression gate tracks; the
+    # factor shapes are part of the key (same COO, different rank or
+    # entity count is a different program with a different cost)
+    eff = device_obs.default_efficiency()
+    sig = device_obs.signature_of(u, i, r, valid, U0, V0)
+    eff.capture_cost(
+        "als.train_step", step, u, i, r, valid, U0, V0,
+        signature=sig, defer=True,
+    )
+    t0 = _time.perf_counter()
     U, V = U0, V0
     for _ in range(p.num_iterations):
         U, V = step(u, i, r, valid, U, V)
     U = jax.block_until_ready(U)
+    wall_s = _time.perf_counter() - t0
+    if eff.cached_cost("als.train_step", sig) is None:
+        # settle the residue of the concurrent capture (usually zero: the
+        # analysis compile raced the real compile + N iterations)
+        eff.flush(timeout=30.0)
+    eff.observe(
+        "als.train_step",
+        wall_s / max(p.num_iterations, 1),
+        signature=sig,
+    )
+    # per-device factor attribution: the hook sharded serving/training
+    # extends (ROADMAP item 1) — which device holds how many factor bytes,
+    # and what the solve spent per device of wall clock
+    meter_shards("als.factors", (U, V), seconds=wall_s)
     return ALSState(user_factors=U[:num_users], item_factors=V[:num_items])

@@ -216,6 +216,73 @@ def global_replicated_array(mesh: Mesh, value) -> jax.Array:
     )
 
 
+def shard_attribution(tree: Any) -> dict[str, dict[str, float]]:
+    """Per-device byte/shard attribution of a pytree of jax.Arrays.
+
+    Walks the leaves' ``addressable_shards`` and sums bytes per device
+    label (``platform:id``) — on a sharded mesh each device reports only
+    the slice it actually holds, so an imbalanced placement is visible as
+    imbalanced bytes.  Host numpy leaves contribute nothing.
+    """
+    out: dict[str, dict[str, float]] = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None:
+            continue
+        try:
+            for shard in shards:
+                d = shard.device
+                label = f"{d.platform}:{d.id}"
+                entry = out.setdefault(label, {"bytes": 0.0, "shards": 0})
+                entry["bytes"] += float(
+                    getattr(shard.data, "nbytes", 0) or 0
+                )
+                entry["shards"] += 1
+        except Exception:
+            continue  # deleted/donated buffers mid-walk: skip the leaf
+    return out
+
+
+def meter_shards(
+    fn: str,
+    tree: Any,
+    seconds: float | None = None,
+    registry=None,
+) -> dict[str, dict[str, float]]:
+    """The per-device attribution hook: record where ``fn``'s arrays live.
+
+    Sets ``pio_shard_bytes{fn,device}`` per device and — when ``seconds``
+    is given — observes ``pio_shard_seconds{fn,device}`` with the wall
+    clock the caller measured for the sharded step (every participating
+    device spans the same SPMD wall time; skewed per-device time needs the
+    profiler).  This is the attribution seam sharded serving/training
+    extends: the wave metrics' ``device`` label and these families share
+    the ``platform:id`` labeling.  Returns the attribution map.
+    """
+    from predictionio_tpu.obs.metrics import REGISTRY, STAGE_BUCKETS
+
+    reg = registry or REGISTRY
+    attribution = shard_attribution(tree)
+    if not attribution:
+        return attribution
+    g_bytes = reg.gauge(
+        "pio_shard_bytes",
+        "Bytes of a named array group held per device",
+        labelnames=("fn", "device"),
+    )
+    h_seconds = reg.histogram(
+        "pio_shard_seconds",
+        "Wall seconds of a named sharded step, per participating device",
+        labelnames=("fn", "device"),
+        buckets=STAGE_BUCKETS,
+    )
+    for label, entry in attribution.items():
+        g_bytes.labels(fn, label).set(entry["bytes"])
+        if seconds is not None:
+            h_seconds.labels(fn, label).observe(seconds)
+    return attribution
+
+
 def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0, fill=0):
     """Pad an array along ``axis`` so its size divides evenly for sharding.
 

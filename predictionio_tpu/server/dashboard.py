@@ -13,6 +13,7 @@ import html
 from urllib.parse import quote
 
 from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
+from predictionio_tpu.obs.device import device_snapshot
 from predictionio_tpu.obs.http import add_observability_routes
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
 from predictionio_tpu.obs.quality import QualityMonitor, default_quality
@@ -129,6 +130,53 @@ def _quality_html(quality: QualityMonitor, registry: MetricsRegistry) -> str:
     )
 
 
+def _efficiency_html(registry: MetricsRegistry) -> str:
+    """Device-efficiency panel: achieved-vs-peak per jitted entry point
+    (the /efficiency.json surface, human-shaped) with trend sparklines
+    from the scrape-fed history ring, plus any active recompile storm —
+    the at-a-glance answer to "is the chip earning its keep"."""
+    snap = device_snapshot()
+    peaks = snap["peaks"]
+    rows = []
+    for fn, entry in sorted(snap["functions"].items()):
+        if "achieved_gbps" not in entry:
+            continue  # cost known but never timed: nothing to chart yet
+        spark_gbps = _sparkline(
+            registry.history.series("pio_device_achieved_gbps", (fn,))
+        )
+        rows.append(
+            f"<tr><td>{html.escape(fn)}</td>"
+            f"<td>{entry['calls']}</td>"
+            f"<td>{entry['achieved_gbps']:.3f}</td>"
+            f"<td>{entry['utilization_hbm']:.2%}</td>"
+            f"<td>{entry['achieved_tflops']:.4f}</td>"
+            f"<td>{entry['utilization_mxu']:.2%}</td>"
+            f"<td>{html.escape(entry.get('source', '?'))}</td>"
+            f"<td>{html.escape(spark_gbps)}</td></tr>"
+        )
+    storms = snap["recompiles"]["active_storms"]
+    storm_note = (
+        "<p><b>RECOMPILE STORM:</b> "
+        + ", ".join(html.escape(fn) for fn in sorted(storms))
+        + " — traffic is churning shapes; every wave pays an XLA "
+        "compile</p>"
+        if storms
+        else ""
+    )
+    return (
+        f"<h2>Device efficiency</h2><p>platform: "
+        f"{html.escape(str(snap['platform']))}, peaks: "
+        f"{peaks['hbm_gbps']:g} GB/s HBM / {peaks['tflops']:g} TFLOP/s "
+        f"({html.escape(str(peaks['source']))})</p>"
+        + storm_note
+        + "<table border='1'><tr><th>fn</th><th>calls</th>"
+        "<th>GB/s</th><th>HBM util</th><th>TFLOP/s</th><th>MXU util</th>"
+        "<th>cost source</th><th>trend</th></tr>"
+        + "".join(rows)
+        + "</table>"
+    )
+
+
 def _traces_table_html(n: int = 15, access_key: str | None = None) -> str:
     """Recent root spans; rows with a request id link to the matching
     flight-recorder entry for the full per-request record.  On a key-gated
@@ -236,6 +284,7 @@ def create_dashboard_app(
             f"<th>started</th><th>finished</th><th>result</th></tr>{rows}"
             f"</table>{_health_html(app)}"
             f"{quality_html}"
+            f"{_efficiency_html(REGISTRY)}"
             f"{_traces_table_html(access_key=access_key)}"
             f"{_metrics_table_html(REGISTRY)}</body></html>",
         )

@@ -40,6 +40,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Sequence
 
+from predictionio_tpu.obs import device as device_obs
 from predictionio_tpu.obs.logging import get_request_id, ring_debug
 from predictionio_tpu.obs.metrics import (
     REGISTRY,
@@ -128,6 +129,16 @@ class MicroBatcher:
         self._m_device_time = reg.histogram(
             "pio_microbatch_device_seconds",
             "Per-wave batch_fn (device dispatch) duration",
+        )
+        #: the 4-way split of device_s (host_gather/h2d/compute/d2h, plus
+        #: the unattributed remainder as "other"), labeled by the device
+        #: the engine marked — the per-shard extension point for sharded
+        #: serving (ROADMAP item 1)
+        self._m_stage_time = reg.histogram(
+            "pio_microbatch_stage_seconds",
+            "Per-wave duration split by timeline stage and device",
+            labelnames=("stage", "device"),
+            buckets=device_obs.WAVE_STAGE_BUCKETS,
         )
         self._m_drain_timeout = reg.counter(
             "pio_microbatch_drain_timeout_total",
@@ -326,11 +337,15 @@ class MicroBatcher:
         loop = futures[0].get_loop()
         try:
             # re-bind the wave's tightest deadline around batch_fn so
-            # outbound storage calls inside it stay under budget
-            with deadline_scope(absolute=wave_deadline):
-                results = self._call_batch_fn(items)
+            # outbound storage calls inside it stay under budget; the wave
+            # timeline scope collects the engine's host_gather/h2d/compute/
+            # d2h stage marks so device_s stops being one opaque number
+            with device_obs.wave_timeline() as timeline:
+                with deadline_scope(absolute=wave_deadline):
+                    results = self._call_batch_fn(items)
             device_s = time.perf_counter() - t_dispatch
             self._m_device_time.observe(device_s)
+            breakdown = self._observe_timeline(timeline, device_s)
             # fill per-item timing meta BEFORE resolving the futures:
             # call_soon_threadsafe orders these writes before the
             # submitter's read on the loop thread
@@ -338,6 +353,12 @@ class MicroBatcher:
                 if meta is not None:
                     meta["queue_wait_s"] = round(t_dispatch - t_enq, 6)
                     meta["device_s"] = round(device_s, 6)
+                    meta["device_breakdown"] = breakdown
+                    meta["wave_device"] = timeline.device
+                    if timeline.fn:
+                        meta["wave_fn"] = timeline.fn
+                        meta["wave_flops"] = timeline.flops
+                        meta["wave_bytes"] = timeline.bytes
                     meta["wave_size"] = len(items)
                     meta["wave_seq"] = wave_seq
                     meta["wave_request_ids"] = rids
@@ -353,6 +374,23 @@ class MicroBatcher:
                 self._post(loop, futures, None, e)
             else:
                 self._solo_retry_pass(live, e, wave_seq)
+
+    def _observe_timeline(
+        self, timeline: "device_obs.WaveTimeline", device_s: float
+    ) -> dict[str, float]:
+        """Turn the engine's stage marks into the 4-way (+other) breakdown
+        that sums to ``device_s`` and record the per-stage histograms,
+        labeled by the device the engine marked (the achieved-vs-peak
+        gauges are the engine's own responsibility — it observes into the
+        efficiency tracker with its compute-stage timing, which is also
+        correct when batch_predict runs outside the MicroBatcher)."""
+        breakdown = device_obs.split_breakdown(timeline, device_s)
+        for stage, seconds in breakdown.items():
+            if seconds > 0.0 or stage == "other":
+                self._m_stage_time.labels(stage, timeline.device).observe(
+                    seconds
+                )
+        return breakdown
 
     def _solo_retry_pass(
         self, live: list[tuple], wave_error: BaseException, wave_seq: int
@@ -388,14 +426,23 @@ class MicroBatcher:
                 continue
             t0 = time.perf_counter()
             try:
-                with deadline_scope(absolute=dl):
-                    result = self._call_batch_fn([item])[0]
+                with device_obs.wave_timeline() as timeline:
+                    with deadline_scope(absolute=dl):
+                        result = self._call_batch_fn([item])[0]
             except Exception as e:
                 _post_one(fut, error=e)
                 continue
+            solo_s = time.perf_counter() - t0
+            breakdown = self._observe_timeline(timeline, solo_s)
             if meta is not None:
                 meta["queue_wait_s"] = round(t0 - t_enq, 6)
-                meta["device_s"] = round(time.perf_counter() - t0, 6)
+                meta["device_s"] = round(solo_s, 6)
+                meta["device_breakdown"] = breakdown
+                meta["wave_device"] = timeline.device
+                if timeline.fn:
+                    meta["wave_fn"] = timeline.fn
+                    meta["wave_flops"] = timeline.flops
+                    meta["wave_bytes"] = timeline.bytes
                 meta["wave_size"] = 1
                 meta["wave_seq"] = wave_seq
                 meta["solo_retry"] = True

@@ -136,6 +136,24 @@ def _status_remote(
             q_status == 200
             and quality.get("drift", {}).get("state") == "drifting"
         )
+    # device-efficiency surface (404/401-tolerant like quality): an ACTIVE
+    # recompile storm is an operator-actionable warning — traffic is
+    # churning shapes and every wave pays an XLA compile — but it does not
+    # change the exit code (the server is up and answering)
+    eff_status, efficiency = fetch("/efficiency.json")
+    if eff_status == 200:
+        storms = efficiency.get("recompiles", {}).get("active_storms", {})
+        report["efficiency"] = {
+            "active_recompile_storms": storms,
+            "peaks": efficiency.get("peaks"),
+        }
+        for fn, storm in storms.items():
+            print(
+                f"WARNING: recompile storm active for {fn} "
+                f"({storm.get('signatures', '?')} distinct shape "
+                "signatures; see docs/observability.md#device-efficiency)",
+                file=sys.stderr,
+            )
     _print(report)
     alive = health_status == 200 and health.get("status") == "alive"
     return 0 if alive and ready_status == 200 and not drifting else 1
@@ -900,6 +918,70 @@ def do_check(args) -> int:
     return 1 if report.findings else 0
 
 
+def do_bench(args) -> int:
+    """`pio bench --compare PREV.json [CURRENT.json]`: the perf-regression
+    gate over two BENCH json lines (bench.py output).
+
+    Exit contract: 0 = every gateable metric within --tolerance, 1 = a
+    regression beyond tolerance (the CI gate trips), 2 = usage error or a
+    file missing/old ``schema_version``.  CURRENT defaults to stdin so the
+    gate pipelines directly: ``python bench.py | pio bench --compare
+    BENCH_prev.json``.
+    """
+    from predictionio_tpu.obs.device import compare_bench
+
+    def load(path: str, label: str) -> dict | None:
+        try:
+            text = Path(path).read_text()
+        except OSError as e:
+            print(f"usage error: cannot read {label}: {e}", file=sys.stderr)
+            return None
+        return parse(text, label)
+
+    def parse(text: str, label: str) -> dict | None:
+        # bench.py logs to stderr and prints ONE json line to stdout, but a
+        # captured file may carry stray lines: the LAST parseable json
+        # object wins
+        for line in reversed([l for l in text.splitlines() if l.strip()]):
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict):
+                return obj
+        print(f"usage error: no JSON object in {label}", file=sys.stderr)
+        return None
+
+    previous = load(args.compare, "--compare file")
+    if previous is None:
+        return 2
+    if args.current:
+        current = load(args.current, "current file")
+    else:
+        current = parse(sys.stdin.read(), "stdin")
+    if current is None:
+        return 2
+    code, report = compare_bench(
+        current, previous, tolerance_pct=args.tolerance
+    )
+    _print(report)
+    if "error" in report:
+        print(f"usage error: {report['error']}", file=sys.stderr)
+    elif report["regressions"]:
+        names = ", ".join(r["metric"] for r in report["regressions"])
+        print(
+            f"PERF REGRESSION beyond {args.tolerance:g}%: {names}",
+            file=sys.stderr,
+        )
+    else:
+        print(
+            f"bench within tolerance ({report['checked']} metrics checked, "
+            f"{len(report['improvements'])} improved)",
+            file=sys.stderr,
+        )
+    return code
+
+
 def do_build(args) -> int:
     """`pio build` parity: engines are plain Python — nothing to compile.
     Validates the engine.json instead (the useful part of the verb)."""
@@ -1272,6 +1354,35 @@ def build_parser() -> argparse.ArgumentParser:
         help="write the current findings to the baseline file and exit 0",
     )
     ck.set_defaults(fn=do_check)
+
+    bn = sub.add_parser(
+        "bench",
+        help="perf-regression gate over two bench.py JSON lines",
+        description="Compare a current BENCH json against a previous one "
+        "and exit 1 on regression beyond tolerance (2 on missing/old "
+        "schema_version) — the CI gate for perf work.",
+    )
+    bn.add_argument(
+        "--compare",
+        required=True,
+        metavar="PREV.json",
+        help="previous BENCH json line/file to gate against",
+    )
+    bn.add_argument(
+        "current",
+        nargs="?",
+        default=None,
+        help="current BENCH json file (default: read stdin, so "
+        "`python bench.py | pio bench --compare PREV.json` works)",
+    )
+    bn.add_argument(
+        "--tolerance",
+        type=float,
+        default=10.0,
+        metavar="PCT",
+        help="allowed regression per metric in percent (default 10)",
+    )
+    bn.set_defaults(fn=do_bench)
 
     bd = sub.add_parser("build")
     bd.add_argument("--engine")

@@ -58,9 +58,9 @@ def test_baseline_entries_are_justified():
 
 
 def test_baseline_did_not_grow():
-    """The model-quality subsystem (obs/quality.py + its wiring) landed
-    with ZERO new baseline entries: the justified baseline stays at the
-    13 entries PR 2 curated."""
+    """Each obs subsystem (model quality in PR 4, device efficiency in
+    PR 6) landed with ZERO new baseline entries: the justified baseline
+    stays at the 13 entries PR 2 curated."""
     assert len(Baseline.load(BASELINE).entries) == 13
 
 
@@ -111,6 +111,48 @@ def test_quality_module_lint_clean_with_zero_pragmas():
         e for e in Baseline.load(BASELINE).entries if e.file == quality_file
     ]
     assert baselined == []
+
+
+def test_device_module_lint_clean_with_zero_pragmas():
+    """The device-efficiency module runs on the serving hot path (wave
+    timeline marks, signature accounting per wave) and is imported by every
+    daemon through obs.http: it must be `pio check`-clean with NO pragma
+    suppressions and NO baseline entries — same bar as the rest of obs/."""
+    report = analyze_paths([PACKAGE / "obs" / "device.py"], root=REPO_ROOT)
+    assert report.errors == []
+    assert report.findings == [], "\n".join(f.text() for f in report.findings)
+    assert report.pragma_suppressed == 0
+    device_file = "predictionio_tpu/obs/device.py"
+    baselined = [
+        e for e in Baseline.load(BASELINE).entries if e.file == device_file
+    ]
+    assert baselined == []
+
+
+def test_bench_compare_smoke():
+    """Tier-1 smoke of the perf-regression gate: a synthetic current/prev
+    pair drives `pio bench --compare` through the real CLI — deterministic,
+    CPU-only, no bench run needed.  The full exit contract lives in
+    tests/test_device_obs.py; this anchors the CI-gateable entry point."""
+    import json
+    import tempfile
+
+    from predictionio_tpu.tools.cli import main
+
+    with tempfile.TemporaryDirectory() as tmp:
+        prev = Path(tmp) / "prev.json"
+        cur = Path(tmp) / "cur.json"
+        prev.write_text(
+            json.dumps({"schema_version": 2, "value": 5.0}) + "\n"
+        )
+        cur.write_text(
+            json.dumps({"schema_version": 2, "value": 8.0}) + "\n"
+        )
+        assert main(["bench", "--compare", str(prev), str(cur)]) == 1
+        cur.write_text(
+            json.dumps({"schema_version": 2, "value": 5.1}) + "\n"
+        )
+        assert main(["bench", "--compare", str(prev), str(cur)]) == 0
 
 
 def test_profiler_capture_runs_off_request_thread():
