@@ -99,6 +99,117 @@ def _is_silent_body(body: list[ast.stmt]) -> bool:
     return True
 
 
+#: attribute spellings that publish bytes/text to a path
+_WRITE_ATTRS = ("write_bytes", "write_text")
+
+#: resolved call names that atomically commit a tmp write (attribute
+#: spellings like ``tmp.replace(final)`` are arity-checked in
+#: ``_is_commit_call`` so ``str.replace(old, new)`` never qualifies)
+_COMMIT_CALLS = ("os.replace", "os.rename", "os.renames", "shutil.move")
+
+
+def _write_mode(call: ast.Call, mode_pos: int) -> bool:
+    """True when an ``open(...)``/``.open(...)`` call's mode argument
+    spells write/append.  ``mode_pos`` is the positional index of the
+    mode: 1 for builtin ``open(path, mode)``, 0 for the ``Path.open(mode)``
+    method spelling."""
+    mode = None
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None and len(call.args) > mode_pos:
+        mode = call.args[mode_pos]
+    if not isinstance(mode, ast.Constant) or not isinstance(mode.value, str):
+        return False
+    return any(c in mode.value for c in "wax+")
+
+
+def _is_commit_call(node: ast.Call, mod: ModuleInfo) -> bool:
+    """An atomic-rename commit step.  Attribute spellings are arity-
+    checked so ``str.replace(old, new)`` (two args) never passes for
+    ``Path.replace(target)`` (one arg); ``os.replace``/``shutil.move``
+    resolve by name regardless of arity."""
+    if resolve_call(mod, node) in _COMMIT_CALLS:
+        return True
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    attr = node.func.attr
+    if attr in ("replace", "rename") and len(node.args) == 1:
+        return True  # pathlib: tmp.replace(final) / tmp.rename(final)
+    if attr in ("mv", "move", "renames"):
+        return True  # fsspec/shutil-style two-arg movers; str has neither
+    return False
+
+
+def _function_commits(fn: ast.AST, mod: ModuleInfo) -> bool:
+    """Does this function ever rename/replace something into place?"""
+    return any(
+        isinstance(node, ast.Call) and _is_commit_call(node, mod)
+        for node in ast.walk(fn)
+    )
+
+
+@rule
+class DirectWriteToPersistencePath(Rule):
+    """PIO-RES003: storage-module write without a tmp-write + rename
+    commit step."""
+
+    id = "PIO-RES003"
+    severity = Severity.MEDIUM
+    summary = (
+        "direct write to a final persistence path; a crash mid-write "
+        "leaves a torn blob readers will load — write a tmp file and "
+        "rename/replace it into place"
+    )
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        # persistence modules only: the data/storage backends and fixtures
+        # shaped like them — the tmp-write + atomic-rename contract is what
+        # makes lifecycle generation flips crash-safe
+        if "storage" not in mod.rel.replace("\\", "/"):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            is_write = (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _WRITE_ATTRS
+            )
+            if not is_write:
+                if resolve_call(mod, node) == "open":
+                    is_write = _write_mode(node, mode_pos=1)
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "open"
+                ):
+                    # method spelling: Path.open("w") / fs.open(path, "wb")
+                    # — the mode may sit at either position
+                    is_write = _write_mode(node, mode_pos=0) or _write_mode(
+                        node, mode_pos=1
+                    )
+            if not is_write:
+                continue
+            fn = enclosing_function(node)
+            if fn is not None and _function_commits(fn, mod):
+                continue  # tmp-write + rename/replace: the durable pattern
+            if fn is None and _function_commits(mod.tree, mod):
+                continue  # module-level write with a module-level commit
+            target = (
+                node.func.attr
+                if isinstance(node.func, ast.Attribute)
+                else "open"
+            )
+            yield self.finding(
+                mod,
+                node,
+                f"{target}(...) writes the final persistence path directly: "
+                "a crash between the first byte and the last leaves a torn "
+                "blob that later reads will trust; write to a uniquely-"
+                "named tmp file, fsync it, then os.replace() it into place "
+                "(see data/storage/localfs_models.py)",
+            )
+
+
 @rule
 class SilentExceptionSwallowOnHotPath(Rule):
     """PIO-RES002: ``except Exception: pass`` inside a serving hot-path

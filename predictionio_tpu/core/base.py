@@ -59,6 +59,11 @@ class EngineContext:
     storage: StorageRuntime | None = None
     seed: int = 0
     mode: str = "train"  # train | eval | serving | batchpredict
+    #: previous generation's persisted per-algorithm models (set by
+    #: ``run_train(warm_start_from=...)``) — algorithms that understand the
+    #: shape seed their init from it (ALS factors, NCF embedding tables);
+    #: everything else ignores it and trains cold
+    warm_start: Any = None
     _mesh: Any = None
 
     @property

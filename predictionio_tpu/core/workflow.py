@@ -82,6 +82,7 @@ def run_train(
     engine_variant: str = "default",
     engine_factory: str = "",
     storage: StorageRuntime | None = None,
+    warm_start_from: str | None = None,
 ) -> EngineInstance | None:
     """Train, persist models, and record the engine instance.
 
@@ -89,9 +90,31 @@ def run_train(
     stopped early by stop_after_read/stop_after_prepare (no instance row is
     kept).  On failure the row is left in status FAILED and the exception
     re-raised.
+
+    ``warm_start_from`` names a previous engine instance whose persisted
+    models seed this run (``ctx.warm_start``): the lifecycle controller's
+    incremental-retrain handle — ALS solves start from the previous
+    factors, NCF from the previous embedding tables — so reacting to drift
+    costs a fraction of a cold train.  A missing/unreadable previous model
+    degrades to a cold start (logged), never a failed retrain.
     """
     storage = storage or get_storage()
     ctx = ctx or EngineContext(storage=storage)
+    if warm_start_from is not None and ctx.warm_start is None:
+        from predictionio_tpu.core.persistence import load_models
+
+        try:
+            ctx.warm_start = load_models(storage.models(), warm_start_from)
+        except Exception as e:
+            log.warning(
+                "warm start from instance %s failed (%s); training cold",
+                warm_start_from, e,
+            )
+        if ctx.warm_start is None:
+            log.warning(
+                "no persisted models for warm-start instance %s; training "
+                "cold", warm_start_from,
+            )
     wp = workflow_params or WorkflowParams()
     instances = storage.engine_instances()
     instance = EngineInstance(

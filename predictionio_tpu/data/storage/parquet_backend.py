@@ -28,6 +28,7 @@ can each take a shard range (SURVEY §7 step 9).
 from __future__ import annotations
 
 import json
+import os
 import shutil
 import threading
 import time
@@ -123,7 +124,11 @@ class ParquetClient:
         d.mkdir(parents=True, exist_ok=True)
         meta = d / "_meta.json"
         if not meta.exists():
-            meta.write_text(json.dumps({"n_shards": self.n_shards_default}))
+            # tmp + atomic replace: a crash mid-write must not leave a torn
+            # _meta.json that breaks every later n_shards() read (PIO-RES003)
+            tmp = d / f"_meta.{os.getpid()}.tmp"
+            tmp.write_text(json.dumps({"n_shards": self.n_shards_default}))
+            os.replace(tmp, meta)
         return d
 
     def close(self) -> None:

@@ -1,0 +1,39 @@
+"""Zero-downtime model lifecycle: crash-safe generations, canary rollout,
+and drift-triggered warm-start retraining (docs/robustness.md#model-lifecycle).
+"""
+
+from predictionio_tpu.lifecycle.canary import (
+    CANARY_VARIANT,
+    CanaryDecider,
+    CanaryPolicy,
+    CanaryTracker,
+    in_canary_fraction,
+)
+from predictionio_tpu.lifecycle.controller import (
+    LifecycleController,
+    LifecyclePolicy,
+    default_retrain,
+)
+from predictionio_tpu.lifecycle.generations import (
+    CorruptModelError,
+    Generation,
+    GenerationStore,
+    LifecycleError,
+    compute_checksum,
+)
+
+__all__ = [
+    "CANARY_VARIANT",
+    "CanaryDecider",
+    "CanaryPolicy",
+    "CanaryTracker",
+    "CorruptModelError",
+    "Generation",
+    "GenerationStore",
+    "LifecycleController",
+    "LifecycleError",
+    "LifecyclePolicy",
+    "compute_checksum",
+    "default_retrain",
+    "in_canary_fraction",
+]

@@ -218,8 +218,12 @@ class NCFAlgorithm(Algorithm):
                 f"no positive interactions (rating >= {p.positive_threshold})"
             )
         mesh = ctx.mesh if ctx.mesh.devices.size > 1 else None
-        initial = None
-        if p.pretrain == "als":
+        # warm start from the previous generation's embedding tables (the
+        # lifecycle controller's incremental retrain): the same §3.4.1
+        # pretraining recipe, with last generation's trained tables in the
+        # ALS pretrainer's role — takes precedence over re-running ALS
+        initial = self._warm_start_initial(ctx, pd)
+        if initial is None and p.pretrain == "als":
             from predictionio_tpu.ops.als import ALSParams, train_als
 
             als = train_als(
@@ -263,6 +267,44 @@ class NCFAlgorithm(Algorithm):
         return NCFModel(
             state=state, user_vocab=pd.user_vocab, item_vocab=pd.item_vocab
         )
+
+    def _warm_start_initial(self, ctx: EngineContext, pd: PreparedData):
+        """Previous-generation GMF/packed embedding tables mapped through
+        the old→new vocab (core.warmstart) — None when absent or when the
+        embedding width changed (cold start is always safe)."""
+        from predictionio_tpu.core.warmstart import (
+            align_warm_factors,
+            find_warm_start,
+        )
+
+        prev = find_warm_start(
+            ctx, ("params", "user_vocab", "item_vocab")
+        )
+        if prev is None or not isinstance(prev.get("params"), dict):
+            return None
+        params = prev["params"]
+        user_emb = params.get("user_emb")
+        item_emb = params.get("item_emb")
+        if user_emb is None or item_emb is None:
+            return None
+        d = self.params.embed_dim
+        user_emb = np.asarray(user_emb)
+        item_emb = np.asarray(item_emb)
+        if user_emb.ndim != 2 or user_emb.shape[1] < d or item_emb.shape[1] < d:
+            return None
+        rng = np.random.default_rng(self.params.seed)
+        return {
+            # the GMF half packs first ([:, :d]) in the packed layout, so
+            # slicing recovers it from either a pure-GMF or packed table
+            "user_emb": align_warm_factors(
+                user_emb[:, :d], BiMap.from_state(prev["user_vocab"]),
+                pd.user_vocab, rng,
+            ),
+            "item_emb": align_warm_factors(
+                item_emb[:, :d], BiMap.from_state(prev["item_vocab"]),
+                pd.item_vocab, rng,
+            ),
+        }
 
     def predict(self, model: NCFModel, query: Query) -> PredictedResult:
         """Solo query from the HOST replica: no device dispatch, so no

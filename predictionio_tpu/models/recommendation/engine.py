@@ -32,6 +32,7 @@ from predictionio_tpu.core import (
     Serving,
 )
 from predictionio_tpu.core.engine import engine_factory
+from predictionio_tpu.core.warmstart import align_warm_factors, find_warm_start
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.obs import device as device_obs
 from predictionio_tpu.ops.als import ALSParams, ALSState, train_als
@@ -284,6 +285,7 @@ class ALSAlgorithm(Algorithm):
             num_items=len(pd.item_vocab),
             params=self._als_params(),
             mesh=ctx.mesh,
+            init_factors=self._warm_start_init(ctx, pd),
         )
         return ALSModel(
             user_factors=state.user_factors,
@@ -291,6 +293,33 @@ class ALSAlgorithm(Algorithm):
             user_vocab=pd.user_vocab,
             item_vocab=pd.item_vocab,
         )
+
+    def _warm_start_init(
+        self, ctx: EngineContext, pd: PreparedData
+    ) -> tuple[np.ndarray, np.ndarray] | None:
+        """Previous-generation factors mapped through the old→new vocab —
+        the lifecycle controller's incremental-retrain seed.  Entities
+        present in both generations keep their trained rows; new entities
+        get the standard random init.  Anything unusable (different rank,
+        foreign persisted shape) degrades to a cold start."""
+        prev = find_warm_start(
+            ctx, ("user_factors", "item_factors", "user_vocab", "item_vocab")
+        )
+        if prev is None:
+            return None
+        rank = self.params.rank
+        Uw = np.asarray(prev["user_factors"], np.float32)
+        Vw = np.asarray(prev["item_factors"], np.float32)
+        if Uw.ndim != 2 or Uw.shape[1] != rank or Vw.shape[1] != rank:
+            return None
+        rng = np.random.default_rng(self.params.seed)
+        U0 = align_warm_factors(
+            Uw, BiMap.from_state(prev["user_vocab"]), pd.user_vocab, rng
+        )
+        V0 = align_warm_factors(
+            Vw, BiMap.from_state(prev["item_vocab"]), pd.item_vocab, rng
+        )
+        return U0, V0
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         """Solo-query path: host numpy replica (P2L local-model serving).

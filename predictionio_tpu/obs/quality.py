@@ -781,6 +781,45 @@ class QualityMonitor:
                 if now - vstats["gauges_ts"] >= _GAUGE_INTERVAL_S:
                     self._set_metric_gauges(variant, vstats, now)
 
+    def variant_metrics(self, variant: str) -> dict[str, float | None] | None:
+        """Force-computed rolling metrics for ONE variant (None when the
+        variant has logged nothing) — the lifecycle controller's read."""
+        now = _now()
+        with self._lock:
+            vstats = self._variants.get(variant)
+            if vstats is None:
+                return None
+            return self._compute_metrics(vstats, now)
+
+    def compare_variants(
+        self, live: str, canary: str, metric: str = "hit_rate"
+    ) -> dict[str, Any]:
+        """Canary-vs-live comparison on one online metric — what gates a
+        canary promotion: the values, and the joined-sample counts that
+        say how much evidence backs them."""
+        now = _now()
+        with self._lock:
+            out: dict[str, Any] = {"metric": metric}
+            for label, key in ((live, "live"), (canary, "canary")):
+                vstats = self._variants.get(label)
+                if vstats is None:
+                    out[f"{key}_value"] = None
+                    out[f"{key}_joined"] = 0
+                    continue
+                metrics = self._compute_metrics(vstats, now)
+                out[f"{key}_value"] = metrics.get(metric)
+                out[f"{key}_joined"] = int(
+                    metrics.get("joined_in_window") or 0
+                )
+        return out
+
+    def record_for(self, request_id: str) -> dict[str, Any] | None:
+        """Copy of the logged prediction record for one request id (swap-
+        atomicity tests assert the logged variant matches the answer)."""
+        with self._lock:
+            rec = self._by_rid.get(request_id)
+            return dict(rec) if rec is not None else None
+
     def drift_state(self) -> str:
         """Worst alert state across every tracked distribution."""
         with self._lock:

@@ -43,7 +43,9 @@ class FaultInjected(Exception):
     """An injected application-level fault (kind="error")."""
 
 
-#: kind -> exception factory; "latency"/"slow_response" sleep instead
+#: kind -> exception factory; "latency"/"slow_response" sleep instead;
+#: "corrupt" mutates bytes at data seams (FaultInjector.corrupt) and is
+#: inert at raise/delay seams
 _KIND_ERRORS: dict[str, Callable[[str], BaseException]] = {
     "error": FaultInjected,
     "connection_reset": ConnectionResetError,
@@ -51,7 +53,7 @@ _KIND_ERRORS: dict[str, Callable[[str], BaseException]] = {
     "timeout": TimeoutError,
 }
 
-_KINDS = frozenset(_KIND_ERRORS) | {"latency", "slow_response"}
+_KINDS = frozenset(_KIND_ERRORS) | {"latency", "slow_response", "corrupt"}
 
 
 @dataclass
@@ -106,6 +108,8 @@ class FaultInjector:
         for r in self.rules:
             if r.seam != seam or (r.match and r.match not in label):
                 continue
+            if r.kind == "corrupt":
+                continue  # data-mutation rules only fire through corrupt()
             with self._lock:
                 n = r.seen
                 r.seen += 1
@@ -122,6 +126,36 @@ class FaultInjector:
             raise _KIND_ERRORS[r.kind](
                 f"{r.message} [{r.kind} @ {seam} {label}]".strip()
             )
+
+    def corrupt(self, seam: str, label: str, data: bytes) -> bytes:
+        """Data-seam injection: deterministically flip bytes when a
+        ``kind="corrupt"`` rule matches (same after/count/probability
+        bookkeeping as :meth:`check`).  Used by checksum-verified readers
+        (lifecycle generation store) to prove corrupt blobs are refused —
+        the mutation is a bit-flip per 1 KiB page, so any real checksum
+        catches it."""
+        for r in self.rules:
+            if r.seam != seam or r.kind != "corrupt":
+                continue
+            if r.match and r.match not in label:
+                continue
+            with self._lock:
+                n = r.seen
+                r.seen += 1
+                if n < r.after:
+                    continue
+                if r.count is not None and r.fired >= r.count:
+                    continue
+                if r.probability < 1.0 and self._rng.random() >= r.probability:
+                    continue
+                r.fired += 1
+            if not data:
+                continue
+            out = bytearray(data)
+            for i in range(0, len(out), 1024):
+                out[i] ^= 0xFF
+            return bytes(out)
+        return data
 
     def snapshot(self) -> list[dict[str, Any]]:
         with self._lock:

@@ -20,6 +20,7 @@ program against them.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import logging
@@ -28,22 +29,31 @@ import threading
 import time
 from dataclasses import dataclass
 from datetime import datetime, timezone
-from typing import Any, Callable
+from typing import Any, Callable, NamedTuple
 
-from predictionio_tpu.core.base import EngineContext
+from predictionio_tpu.core.base import EngineContext, run_sanity_check
 from predictionio_tpu.core.engine import Engine, resolve_engine_factory
 from predictionio_tpu.core.persistence import load_models
 from predictionio_tpu.data.datamap import DataMap
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage.base import EngineInstance
 from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
+from predictionio_tpu.lifecycle.canary import CANARY_VARIANT, in_canary_fraction
+from predictionio_tpu.lifecycle.generations import (
+    CorruptModelError,
+    GenerationStore,
+)
 from predictionio_tpu.obs.flight import annotate
 from predictionio_tpu.obs.http import add_observability_routes
 from predictionio_tpu.obs.logging import get_request_id
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
-from predictionio_tpu.obs.quality import QualityMonitor, default_quality
+from predictionio_tpu.obs.quality import (
+    DEFAULT_ENTITY_FIELDS,
+    QualityMonitor,
+    default_quality,
+)
 from predictionio_tpu.obs.tracing import trace
-from predictionio_tpu.resilience import LoadShed
+from predictionio_tpu.resilience import LoadShed, faults
 from predictionio_tpu.resilience.admission import AdmissionController
 from predictionio_tpu.resilience.deadline import DeadlineExceeded
 from predictionio_tpu.resilience.degrade import degraded_scope
@@ -54,11 +64,30 @@ from predictionio_tpu.server.httpd import (
     Response,
     error_response,
     json_response,
+    key_matches,
     shed_response,
 )
 from predictionio_tpu.utils.params import extract_params
 
 log = logging.getLogger("predictionio_tpu.serving")
+
+#: response headers naming the generation that answered — the swap-
+#: atomicity contract: header, body, and the quality log always agree
+INSTANCE_HEADER = "X-Pio-Engine-Instance"
+VARIANT_HEADER = "X-Pio-Variant"
+
+
+class Binding(NamedTuple):
+    """One generation's immutable serving snapshot.  Every request/wave
+    captures exactly one Binding, so a concurrent swap can never hand it a
+    torn mix of old algorithms and new models."""
+
+    instance: EngineInstance
+    params: Any
+    algorithms: list
+    models: list
+    serving: Any
+    role: str  # "live" | "canary"
 
 
 def _render_prediction(p: Any) -> Any:
@@ -98,7 +127,25 @@ class FeedbackConfig:
 
 
 class DeployedEngine:
-    """Engine + materialized models for one engine instance, hot-swappable."""
+    """Engine + materialized models for one engine instance, hot-swappable.
+
+    Holds up to TWO bound generations: the **live** one (the legacy
+    ``instance/params/algorithms/models/serving`` attributes, kept as plain
+    attributes for compatibility) and an optional **canary**.  Every flip
+    (swap, promote, rollback) replaces whole attribute sets under one lock;
+    readers snapshot a whole :class:`Binding` once per request/wave, so
+    in-flight work finishes on the generation it started on and no request
+    ever sees a torn model.  The per-generation in-flight counter gives
+    ``wait_drained`` — the drain step after a flip retires the loser.
+    """
+
+    #: class-level defaults so test stubs built via ``__new__`` (no
+    #: __init__) still satisfy every method's attribute reads
+    generation_store: GenerationStore | None = None
+    _canary_binding: Binding | None = None
+    _canary_fraction: float = 0.0
+    _drain_cond: threading.Condition | None = None
+    entity_fields: tuple[str, ...] = DEFAULT_ENTITY_FIELDS
 
     def __init__(
         self,
@@ -106,14 +153,23 @@ class DeployedEngine:
         instance: EngineInstance,
         storage: StorageRuntime,
         ctx: EngineContext | None = None,
+        generation_store: GenerationStore | None = None,
     ):
         self.engine = engine
         self.storage = storage
         self.ctx = ctx or EngineContext(storage=storage, mode="serving")
+        self.generation_store = generation_store
         self._lock = threading.RLock()
+        self._drain_cond = threading.Condition()
+        self._inflight: dict[str, int] = {}
         self._bind(instance)
 
-    def _bind(self, instance: EngineInstance) -> None:
+    # -- binding construction ------------------------------------------------
+
+    def load_binding(self, instance: EngineInstance, role: str = "live") -> Binding:
+        """Materialize one generation WITHOUT flipping anything — the slow
+        half of a swap, done outside the lock so serving never stalls on a
+        model load."""
         params = self.engine.params_from_json(_instance_variant(instance))
         persisted = load_models(self.storage.models(), instance.id)
         if persisted is None:
@@ -124,15 +180,165 @@ class DeployedEngine:
             self.ctx, params, persisted, instance_id=instance.id
         )
         _, _, algos, serving = self.engine.instantiate(params)
+        return Binding(instance, params, algos, models, serving, role)
+
+    def _install_live(self, binding: Binding) -> None:
         with self._lock:
-            self.instance = instance
-            self.params = params
-            self.algorithms = algos
-            self.models = models
-            self.serving = serving
+            self.instance = binding.instance
+            self.params = binding.params
+            self.algorithms = binding.algorithms
+            self.models = binding.models
+            self.serving = binding.serving
+
+    def _bind(self, instance: EngineInstance) -> None:
+        self._install_live(self.load_binding(instance))
+
+    # -- snapshots -----------------------------------------------------------
+
+    def live_binding(self) -> Binding:
+        with self._lock:
+            return Binding(
+                self.instance, getattr(self, "params", None),
+                self.algorithms, self.models, self.serving, "live",
+            )
+
+    def canary_binding(self) -> Binding | None:
+        with self._lock:
+            return self._canary_binding
+
+    def canary_split(self) -> tuple[Binding | None, float]:
+        with self._lock:
+            return self._canary_binding, self._canary_fraction
+
+    @property
+    def canary_instance(self) -> EngineInstance | None:
+        b = self._canary_binding
+        return b.instance if b is not None else None
+
+    @property
+    def variant_label(self) -> str:
+        return getattr(self.instance, "engine_variant", None) or "default"
+
+    def binding_label(self, binding: Binding) -> str:
+        return (
+            CANARY_VARIANT if binding.role == "canary" else self.variant_label
+        )
+
+    def binding_for_entity(self, entity: str | None) -> Binding:
+        """Route one query: canary when one is staged AND the entity
+        hashes into its fraction (deterministic per entity), else live."""
+        with self._lock:
+            canary = self._canary_binding
+            fraction = self._canary_fraction
+        if canary is not None and in_canary_fraction(entity, fraction):
+            return canary
+        return self.live_binding()
+
+    def payload_entity(self, payload: Any) -> str | None:
+        """The joinable entity id of a query payload (same fields the
+        quality joiner keys on)."""
+        if isinstance(payload, dict):
+            for f in self.entity_fields:
+                v = payload.get(f)
+                if v is not None:
+                    return str(v)
+        return None
+
+    # -- in-flight tracking (the drain half of a swap) -----------------------
+
+    @contextlib.contextmanager
+    def serving_slot(self, binding: Binding):
+        cond = self._drain_cond
+        if cond is None:  # minimal test stubs: no drain bookkeeping
+            yield
+            return
+        iid = binding.instance.id
+        with cond:
+            self._inflight[iid] = self._inflight.get(iid, 0) + 1
+        try:
+            yield
+        finally:
+            with cond:
+                n = self._inflight.get(iid, 1) - 1
+                if n <= 0:
+                    self._inflight.pop(iid, None)
+                else:
+                    self._inflight[iid] = n
+                cond.notify_all()
+
+    def wait_drained(self, instance_id: str, timeout: float = 5.0) -> bool:
+        """Block until no in-flight request references the generation —
+        the ``draining`` step that lets a flip retire the old model."""
+        cond = self._drain_cond
+        if cond is None:
+            return True
+        with cond:
+            return cond.wait_for(
+                lambda: self._inflight.get(instance_id, 0) == 0, timeout
+            )
+
+    # -- lifecycle transitions ----------------------------------------------
+
+    def stage_canary(self, instance: EngineInstance, fraction: float) -> None:
+        """Bind a staged generation as the canary (built outside the lock,
+        flipped under it)."""
+        binding = self.load_binding(instance, role="canary")
+        with self._lock:
+            self._canary_binding = binding
+            self._canary_fraction = fraction
+
+    def promote_canary(self) -> EngineInstance:
+        """Atomic in-memory flip: the canary becomes live in one lock
+        region — a request admitted before the flip finishes on the old
+        binding it captured; one admitted after sees only the new one."""
+        with self._lock:
+            binding = self._canary_binding
+            if binding is None:
+                raise RuntimeError("no canary generation to promote")
+            old = self.instance
+            self._install_live(binding._replace(role="live"))
+            self._canary_binding = None
+            self._canary_fraction = 0.0
+        log.info(
+            "promoted generation %s (was %s)", binding.instance.id, old.id
+        )
+        return binding.instance
+
+    def clear_canary(self) -> None:
+        with self._lock:
+            self._canary_binding = None
+            self._canary_fraction = 0.0
+
+    def verify_and_swap(self, instance: EngineInstance) -> None:
+        """The gated /reload path: checksum + sanity-verify the candidate,
+        THEN commit the manifest, THEN flip — any failure leaves the old
+        generation serving untouched.  Raises on refusal."""
+        store = self.generation_store
+        if store is not None:
+            gen = store.get(instance.id)
+            if gen is None:
+                gen = store.record(instance.id, status="staged")
+            store.verify(gen)  # CorruptModelError on checksum mismatch
+        binding = self.load_binding(instance)
+        for m in binding.models:
+            run_sanity_check(m)
+        if faults.ACTIVE is not None:
+            # the crash-mid-swap seam: chaos plans stall/kill here, BETWEEN
+            # verification and the manifest commit — a restart must come
+            # back on the still-committed last-good generation
+            faults.ACTIVE.check("lifecycle.swap", f"reload {instance.id}")
+        old = self.instance
+        if store is not None:
+            store.promote(instance.id, note="reload")
+        self._install_live(binding)
+        if old.id != instance.id:
+            # idempotent reload of the already-bound instance must not
+            # stall behind its own steady traffic
+            self.wait_drained(old.id, timeout=5.0)
 
     def reload_latest(self) -> EngineInstance:
-        """Re-bind to the latest COMPLETED instance (MasterActor ReloadServer)."""
+        """Verify + swap to the latest COMPLETED instance (MasterActor
+        ReloadServer) — same verification gate as the lifecycle paths."""
         latest = self.storage.engine_instances().get_latest_completed(
             self.instance.engine_id,
             self.instance.engine_version,
@@ -140,8 +346,10 @@ class DeployedEngine:
         )
         if latest is None:
             raise RuntimeError("no COMPLETED engine instance to reload")
-        self._bind(latest)
+        self.verify_and_swap(latest)
         return latest
+
+    # -- serving -------------------------------------------------------------
 
     def extract_query(self, query_payload: dict) -> Any:
         with self._lock:
@@ -149,22 +357,32 @@ class DeployedEngine:
         return _extract_query(algorithms, query_payload)
 
     def predict(self, query: Any) -> tuple[Any, Any]:
-        with self._lock:
-            algorithms, models, serving = self.algorithms, self.models, self.serving
-        query = serving.supplement(query)
+        return self.predict_bound(self.live_binding(), query)
+
+    def predict_bound(self, binding: Binding, query: Any) -> tuple[Any, Any]:
+        if binding.role == "canary" and faults.ACTIVE is not None:
+            faults.ACTIVE.check("canary.predict", binding.instance.id)
+        query = binding.serving.supplement(query)
         predictions = [
-            a.predict(m, query) for a, m in zip(algorithms, models)
+            a.predict(m, query)
+            for a, m in zip(binding.algorithms, binding.models)
         ]
-        return query, serving.serve(query, predictions)
+        return query, binding.serving.serve(query, predictions)
 
     def predict_batch(self, queries: list[Any]) -> list[tuple[Any, Any]]:
+        return self.predict_batch_bound(self.live_binding(), queries)
+
+    def predict_batch_bound(
+        self, binding: Binding, queries: list[Any]
+    ) -> list[tuple[Any, Any]]:
         """Serve a coalesced wave of queries in one vectorized
         ``batch_predict`` pass per algorithm — the MicroBatcher target."""
-        with self._lock:
-            algorithms, models, serving = self.algorithms, self.models, self.serving
+        if binding.role == "canary" and faults.ACTIVE is not None:
+            faults.ACTIVE.check("canary.predict", binding.instance.id)
+        serving = binding.serving
         supplemented = [serving.supplement(q) for q in queries]
         per_algo: list[list[Any]] = []
-        for a, m in zip(algorithms, models):
+        for a, m in zip(binding.algorithms, binding.models):
             by_idx = dict(a.batch_predict(m, list(enumerate(supplemented))))
             per_algo.append([by_idx[i] for i in range(len(supplemented))])
         return [
@@ -218,6 +436,14 @@ def create_prediction_server_app(
     #: default per-request time budget in seconds, overridable per request
     #: via the X-Pio-Deadline header (PIO_DEFAULT_DEADLINE_S)
     default_deadline_s: float | None = None,
+    #: closed-loop model lifecycle (docs/robustness.md#model-lifecycle):
+    #: None = env-driven (PIO_LIFECYCLE=1), True/False = explicit; a
+    #: pre-built LifecycleController may be passed for tests
+    enable_lifecycle: bool | None = None,
+    lifecycle: "LifecycleController | None" = None,
+    lifecycle_policy: "LifecyclePolicy | None" = None,
+    #: start the controller's daemon thread (tests drive tick() directly)
+    lifecycle_autostart: bool = True,
 ) -> HTTPApp:
     import os
 
@@ -255,6 +481,41 @@ def create_prediction_server_app(
     variant_label = (
         getattr(deployed.instance, "engine_variant", None) or "default"
     )
+
+    # -- model lifecycle: generation manifest + canary + controller ----------
+    from predictionio_tpu.lifecycle.controller import (
+        LifecycleController,
+        LifecyclePolicy,
+    )
+
+    if enable_lifecycle is None and lifecycle is None:
+        enable_lifecycle = os.environ.get("PIO_LIFECYCLE", "").lower() in (
+            "1", "on", "true", "yes",
+        )
+    if lifecycle is None and enable_lifecycle:
+        if deployed.generation_store is None:
+            log.warning(
+                "lifecycle requested but the deployed engine has no "
+                "generation store; controller disabled"
+            )
+        else:
+            lifecycle = LifecycleController(
+                deployed,
+                deployed.generation_store,
+                quality=quality,
+                policy=lifecycle_policy or LifecyclePolicy.from_env(),
+                registry=registry,
+            )
+    app.lifecycle = lifecycle
+    canary_tracker = lifecycle.tracker if lifecycle is not None else None
+    if lifecycle is not None and lifecycle_autostart:
+        lifecycle.start()
+
+    def _observe_variant(binding_role: str, status: int, dt: float) -> None:
+        """Feed the canary guardrail stats (error rate + latency per
+        variant) — a no-op rollout-wise until a canary starts."""
+        if canary_tracker is not None:
+            canary_tracker.observe(binding_role == "canary", status, dt)
 
     # /readyz: a load balancer should only route here when the model is
     # bound, the MicroBatcher accepts work, and the event store answers
@@ -371,30 +632,43 @@ def create_prediction_server_app(
             raise ValueError("query must be a JSON object")
         return payload, deployed.extract_query(payload)
 
-    def _finish_query(payload, query, prediction, t0: float) -> Response:
+    def _finish_query(payload, query, prediction, t0: float, binding=None) -> Response:
         return _finish_rendered(
-            payload, query, _render_prediction(prediction), t0
+            payload, query, _render_prediction(prediction), t0, binding
         )
 
-    def _finish_rendered(payload, query, rendered, t0: float) -> Response:
-        rendered = plugins.process_output(
-            deployed.instance.id, payload, rendered
+    def _finish_rendered(payload, query, rendered, t0: float, binding=None) -> Response:
+        instance_id = (
+            binding.instance.id if binding is not None else deployed.instance.id
         )
+        answered_variant = (
+            deployed.binding_label(binding)
+            if binding is not None
+            else variant_label
+        )
+        rendered = plugins.process_output(instance_id, payload, rendered)
         if feedback.enabled and feedback.app_id is not None:
             try:
                 _feedback_event(query, rendered)
             except Exception as e:  # feedback must never fail the query
                 log.error("feedback event failed: %s", e)
         dt = _observe("/queries.json", 200, t0)
+        _observe_variant(
+            "canary" if answered_variant == CANARY_VARIANT else "live",
+            200, dt,
+        )
         with stats_lock:
             n = stats["request_count"]
             stats["avg_serving_sec"] = (stats["avg_serving_sec"] * n + dt) / (n + 1)
             stats["last_serving_sec"] = dt
             stats["request_count"] = n + 1
         quality.observe_prediction(
-            get_request_id(), payload, rendered, variant=variant_label
+            get_request_id(), payload, rendered, variant=answered_variant
         )
-        return json_response(200, rendered)
+        resp = json_response(200, rendered)
+        resp.headers[INSTANCE_HEADER] = instance_id
+        resp.headers[VARIANT_HEADER] = answered_variant
+        return resp
 
     if use_microbatch:
         from predictionio_tpu.server.microbatch import MicroBatcher
@@ -412,13 +686,17 @@ def create_prediction_server_app(
                     log.error("feedback event failed: %s", e)
             return rendered
 
-        def _predict_bisect(parsed, idxs, out, depth=0):
+        def _predict_bisect(binding, parsed, idxs, out, depth=0):
             """Batched predict with bisection fault isolation: a failing
             wave splits in half and each half retries batched, so P poison
             queries cost O(P log B) extra dispatches instead of turning the
-            whole wave into O(B) solo predicts."""
+            whole wave into O(B) solo predicts.  The whole recursion runs
+            against ONE captured binding — a swap mid-wave cannot mix
+            generations inside a wave."""
             try:
-                results = deployed.predict_batch([parsed[i][1] for i in idxs])
+                results = deployed.predict_batch_bound(
+                    binding, [parsed[i][1] for i in idxs]
+                )
             except DeadlineExceeded:
                 # the wave's bound budget (its TIGHTEST member's) ran out:
                 # not a poison query, so don't bisect — and don't fail the
@@ -436,8 +714,8 @@ def create_prediction_server_app(
                         "wave predict failed; bisecting to isolate"
                     )
                 mid = len(idxs) // 2
-                _predict_bisect(parsed, idxs[:mid], out, depth + 1)
-                _predict_bisect(parsed, idxs[mid:], out, depth + 1)
+                _predict_bisect(binding, parsed, idxs[:mid], out, depth + 1)
+                _predict_bisect(binding, parsed, idxs[mid:], out, depth + 1)
                 return
             for i, (q, pred) in zip(idxs, results):
                 out[i] = ("pred", (q, pred))
@@ -445,13 +723,29 @@ def create_prediction_server_app(
         def _serve_wave(payloads):
             """Whole wave on the worker thread: extract + vectorized predict
             + render/plugins/feedback.  Returns per item one of
-            ("ok", rendered, degraded) | ("bad", err, ()) -> 400 |
-            ("err", err, ()) -> 500; a poison query degrades only itself,
-            never the rest of the wave, and a plugin/feedback failure on
-            one item never re-runs prediction for the others.  ``degraded``
-            carries wave-level fallback reasons (an engine that fell back
-            to model-only serving mid-wave marks every answer it produced
-            under that fallback)."""
+            ("ok", rendered, degraded, route) | ("bad", err, (), route) ->
+            400 | ("err", err, (), route) -> 500, where ``route`` is the
+            ``(engine instance id, variant label)`` that answered — the
+            canary split partitions the wave per binding, each partition
+            serving whole against its own captured generation.  A poison
+            query degrades only itself, never the rest of the wave, and a
+            plugin/feedback failure on one item never re-runs prediction
+            for the others.  ``degraded`` carries wave-level fallback
+            reasons (an engine that fell back to model-only serving
+            mid-wave marks every answer it produced under that fallback)."""
+            live_b = deployed.live_binding()
+            canary_b, fraction = deployed.canary_split()
+            bindings: list[Any] = []
+            for pl in payloads:
+                b = live_b
+                if canary_b is not None and in_canary_fraction(
+                    deployed.payload_entity(pl), fraction
+                ):
+                    b = canary_b
+                bindings.append(b)
+            routes = [
+                (b.instance.id, deployed.binding_label(b)) for b in bindings
+            ]
             parsed: list[tuple[str, Any]] = []
             with degraded_scope() as degraded:
                 for pl in payloads:
@@ -460,9 +754,17 @@ def create_prediction_server_app(
                     except Exception as e:
                         parsed.append(("bad", e))
                 out: list[Any] = [(tag, v, ()) for tag, v in parsed]
-                ok_idx = [i for i, (tag, _) in enumerate(parsed) if tag == "q"]
-                if ok_idx:
-                    _predict_bisect(parsed, ok_idx, out)
+                for b in (live_b, canary_b):
+                    if b is None:
+                        continue
+                    ok_idx = [
+                        i for i, (tag, _) in enumerate(parsed)
+                        if tag == "q" and bindings[i] is b
+                    ]
+                    if not ok_idx:
+                        continue
+                    with deployed.serving_slot(b):
+                        _predict_bisect(b, parsed, ok_idx, out)
                 for i, entry in enumerate(out):
                     if entry[0] != "pred":
                         continue
@@ -475,7 +777,10 @@ def create_prediction_server_app(
                         )
                     except Exception as e:  # plugin error: only this fails
                         out[i] = ("err", e, ())
-            return out
+            return [
+                (entry[0], entry[1], entry[2], routes[i])
+                for i, entry in enumerate(out)
+            ]
 
         batcher = MicroBatcher(
             _serve_wave,
@@ -514,10 +819,11 @@ def create_prediction_server_app(
             # the worker fills meta with this query's queue-wait/device
             # split + wave mates; annotate() hands it to the flight recorder
             meta: dict[str, Any] = {}
+            route_info: tuple[str, str] | None = None
             try:
                 with trace("serve.microbatch", record=False):
-                    status, value, degraded = await batcher.submit(
-                        payload, meta
+                    status, value, degraded, route_info = (
+                        await batcher.submit(payload, meta)
                     )
             except LoadShed as e:
                 # bounded queue: shed instead of letting the backlog grow —
@@ -536,25 +842,48 @@ def create_prediction_server_app(
             finally:
                 if meta:
                     annotate(**meta)
+            instance_id, answered_variant = route_info or (
+                deployed.instance.id, variant_label,
+            )
+            def _stamped(resp: Response) -> Response:
+                resp.headers[INSTANCE_HEADER] = instance_id
+                resp.headers[VARIANT_HEADER] = answered_variant
+                return resp
+
             if status == "bad":
                 _observe("/queries.json", 400, t0)
-                return error_response(400, f"invalid query: {value}")
+                _observe_variant(
+                    "canary" if answered_variant == CANARY_VARIANT else "live",
+                    400, time.perf_counter() - t0,
+                )
+                return _stamped(error_response(400, f"invalid query: {value}"))
             if status == "err":
                 log.error("query serving failed: %s", value)
                 _observe("/queries.json", 500, t0)
-                return error_response(
-                    500, f"{type(value).__name__}: {value}"
+                _observe_variant(
+                    "canary" if answered_variant == CANARY_VARIANT else "live",
+                    500, time.perf_counter() - t0,
                 )
+                return _stamped(error_response(
+                    500, f"{type(value).__name__}: {value}"
+                ))
             _bump_stats(t0)
+            _observe_variant(
+                "canary" if answered_variant == CANARY_VARIANT else "live",
+                200, time.perf_counter() - t0,
+            )
             quality.observe_prediction(
                 get_request_id(),
                 payload,
                 value,
-                variant=variant_label,
+                variant=answered_variant,
                 wave_size=meta.get("wave_size"),
                 wave_seq=meta.get("wave_seq"),
             )
-            resp = json_response(200, value)
+            # the swap-atomicity contract: the generation that answered is
+            # stamped on the response and matches the variant the quality
+            # log recorded for this request id
+            resp = _stamped(json_response(200, value))
             if degraded:
                 # answered from model-only fallback (event store down/over
                 # budget): correct-but-degraded, stamped so clients and
@@ -567,35 +896,100 @@ def create_prediction_server_app(
         @app.route("POST", "/queries\\.json")
         def queries(req: Request) -> Response:
             t0 = time.perf_counter()
+
+            def _stamped(resp: Response, binding=None) -> Response:
+                # every answer — errors included — names the generation
+                # that (would have) answered, so 5xx attribution works
+                # exactly when it matters most
+                resp.headers[INSTANCE_HEADER] = (
+                    binding.instance.id if binding else deployed.instance.id
+                )
+                resp.headers[VARIANT_HEADER] = (
+                    deployed.binding_label(binding) if binding else variant_label
+                )
+                return resp
+
             try:
                 payload, query = _parse_query(req)
             except Exception as e:
                 _observe("/queries.json", 400, t0)
-                return error_response(400, f"invalid query: {e}")
+                return _stamped(error_response(400, f"invalid query: {e}"))
+            binding = deployed.binding_for_entity(
+                deployed.payload_entity(payload)
+            )
             try:
-                with degraded_scope() as degraded:
-                    query, prediction = deployed.predict(query)
+                with deployed.serving_slot(binding), degraded_scope() as degraded:
+                    query, prediction = deployed.predict_bound(binding, query)
             except DeadlineExceeded as e:
                 _observe("/queries.json", 504, t0)
-                return error_response(504, f"deadline exceeded: {e}")
+                return _stamped(
+                    error_response(504, f"deadline exceeded: {e}"), binding
+                )
             except Exception as e:
                 log.exception("query serving failed")
                 _observe("/queries.json", 500, t0)
-                return error_response(500, f"{type(e).__name__}: {e}")
-            resp = _finish_query(payload, query, prediction, t0)
+                _observe_variant(
+                    binding.role, 500, time.perf_counter() - t0
+                )
+                return _stamped(
+                    error_response(500, f"{type(e).__name__}: {e}"), binding
+                )
+            resp = _finish_query(payload, query, prediction, t0, binding)
             if degraded:
                 resp.headers["X-Pio-Degraded"] = ",".join(degraded)
             return resp
 
     def _authorized(req: Request) -> bool:
-        return access_key is None or req.query.get("accessKey") == access_key
+        # Bearer header or ?accessKey= — the same contract as the other
+        # mutating/debug routes (obs/http.py)
+        return access_key is None or key_matches(req, access_key)
 
     @app.route("POST", "/reload")
     def reload(req: Request) -> Response:
+        """Hot-swap to the latest COMPLETED instance — gated behind the
+        generation manifest: the candidate's blob checksum and
+        ``sanity_check()`` run BEFORE the flip, and any refusal answers
+        409 with the reason while the old generation keeps serving."""
         if not _authorized(req):
             return error_response(401, "Invalid accessKey.")
-        inst = deployed.reload_latest()
+        try:
+            inst = deployed.reload_latest()
+        except Exception as e:
+            # verification refused the candidate (corrupt blob, failed
+            # sanity check, no completed instance): 409, old model serves on
+            log.error("reload refused: %s", e)
+            return json_response(
+                409,
+                {
+                    "message": f"reload refused: {e}",
+                    "engineInstanceId": deployed.instance.id,
+                },
+            )
         return json_response(200, {"message": "Reloaded", "engineInstanceId": inst.id})
+
+    @app.route("GET", "/lifecycle\\.json")
+    def lifecycle_json(req: Request) -> Response:
+        """Generation manifest + canary/controller state — gated like the
+        other debug routes."""
+        if not _authorized(req):
+            return error_response(401, "Invalid accessKey.")
+        store = deployed.generation_store
+        body: dict[str, Any] = {
+            "engineInstanceId": deployed.instance.id,
+            "variant": variant_label,
+            "manifest": store.snapshot() if store is not None else None,
+            "controller": (
+                app.lifecycle.snapshot()
+                if app.lifecycle is not None
+                else {"enabled": False}
+            ),
+        }
+        canary = deployed.canary_instance
+        body["canary_in_progress"] = canary is not None
+        if canary is not None:
+            body["canary_instance"] = canary.id
+            body["canary_fraction"] = deployed.canary_split()[1]
+        return json_response(200, body)
 
     # -- plugins (CreateServer.scala:656-702) --------------------------------
     @app.route("GET", "/plugins\\.json")
@@ -641,16 +1035,27 @@ def deploy_engine(
     """Resolve factory + engine instance and materialize models for serving.
 
     Mirrors CreateServer.createPredictionServerWithEngine:193: given an
-    explicit instance id or the latest COMPLETED one for
-    (engine_id, version, variant).
+    explicit instance id, the generation manifest's **live** generation
+    (checksum-verified, with a last-good fallback walk when the head's
+    bytes are corrupt), or the latest COMPLETED instance.  Binding the
+    manifest's live generation — not merely "latest COMPLETED" — is what
+    makes a SIGKILL mid-swap safe: a restart comes back on whichever whole
+    generation the atomic manifest commit last published.
     """
     storage = storage or get_storage()
     instances = storage.engine_instances()
+    gen_store = GenerationStore(
+        storage.models(), engine_id, engine_version, engine_variant
+    )
+    instance = None
+    refused: set[str] = set()
     if engine_instance_id is not None:
         instance = instances.get(engine_instance_id)
         if instance is None:
             raise RuntimeError(f"engine instance {engine_instance_id} not found")
-    else:
+    elif gen_store.exists():
+        instance = _bind_from_manifest(gen_store, instances, refused)
+    if instance is None:
         instance = instances.get_latest_completed(
             engine_id, engine_version, engine_variant
         )
@@ -659,11 +1064,61 @@ def deploy_engine(
                 f"no COMPLETED engine instance for engine {engine_id!r}; "
                 "run train first"
             )
+        if instance.id in refused:
+            # every manifest generation failed its checksum AND the latest
+            # COMPLETED instance is one of the refused ones: re-recording
+            # it live would bless the corruption the gate just caught —
+            # refuse to serve garbage, loudly
+            raise RuntimeError(
+                f"every generation of engine {engine_id!r} failed checksum "
+                f"verification (latest COMPLETED {instance.id} included); "
+                "re-train or restore the model store before deploying"
+            )
+    # record what we bound as the live generation (creates the manifest on
+    # first deploy — best-effort bookkeeping; verification failures at BIND
+    # time for manifest-tracked generations stay strict above)
+    try:
+        live = gen_store.live()
+        if live is None or live.instance_id != instance.id:
+            gen_store.record(instance.id, status="live")
+    except Exception as e:
+        log.warning("could not record live generation in manifest: %s", e)
     factory = resolve_engine_factory(
         engine_factory_name or instance.engine_factory
     )
     engine = factory()
-    return DeployedEngine(engine, instance, storage)
+    return DeployedEngine(engine, instance, storage, generation_store=gen_store)
+
+
+def _bind_from_manifest(
+    gen_store: GenerationStore, instances, refused: set[str] | None = None
+) -> EngineInstance | None:
+    """The startup bind: the manifest's live generation, checksum-verified;
+    corrupt bytes fall back to the most recent previously-live generation
+    instead of crashing (or serving garbage).  Refused instance ids are
+    collected so the caller's latest-COMPLETED fallback never re-blesses
+    a generation the checksum gate just rejected."""
+    for gen in gen_store.bind_candidates():
+        inst = instances.get(gen.instance_id)
+        if inst is None:
+            continue
+        try:
+            gen_store.verify(gen)
+        except CorruptModelError as e:
+            if refused is not None:
+                refused.add(gen.instance_id)
+            REGISTRY.counter(
+                "pio_lifecycle_corrupt_blobs_total",
+                "Model blobs refused by checksum verification",
+            ).inc()
+            log.error(
+                "generation %s refused at bind (%s); falling back to "
+                "last-good", gen.instance_id, e,
+            )
+            gen_store.mark_corrupt(gen.instance_id, str(e))
+            continue
+        return inst
+    return None
 
 
 def undeploy_stale(host: str, port: int, access_key: str | None = None) -> bool:
@@ -702,6 +1157,7 @@ def create_prediction_server(
     max_queue: int | None = None,
     max_inflight: int | None = None,
     default_deadline_s: float | None = None,
+    enable_lifecycle: bool | None = None,
 ):
     """Build the deploy server.
 
@@ -736,6 +1192,7 @@ def create_prediction_server(
         max_queue=max_queue,
         max_inflight=max_inflight,
         default_deadline_s=default_deadline_s,
+        enable_lifecycle=enable_lifecycle,
     )
     if server_kind == "aio":
         from predictionio_tpu.server.aio import AsyncAppServer

@@ -136,6 +136,39 @@ def _status_remote(
             q_status == 200
             and quality.get("drift", {}).get("state") == "drifting"
         )
+    # model-lifecycle surface (404/401-tolerant): a canary in progress is
+    # an operator-actionable WARNING (half-promoted state — don't deploy
+    # over it), and a recent rollback is worth a line; neither changes the
+    # exit code (the server is up and answering on the live generation)
+    lc_status, lifecycle = fetch("/lifecycle.json")
+    if lc_status == 200:
+        report["lifecycle"] = {
+            "live": (lifecycle.get("manifest") or {}).get("live"),
+            "canary_in_progress": lifecycle.get("canary_in_progress"),
+            "rolled_back": (lifecycle.get("manifest") or {}).get(
+                "rolled_back"
+            ),
+        }
+        if lifecycle.get("canary_in_progress"):
+            print(
+                "WARNING: canary rollout in progress "
+                f"(generation {lifecycle.get('canary_instance')} serving "
+                f"{lifecycle.get('canary_fraction', 0):.0%} of traffic; "
+                "see docs/robustness.md#model-lifecycle)",
+                file=sys.stderr,
+            )
+        last_rb = (lifecycle.get("manifest") or {}).get("last_rollback_at")
+        if last_rb:
+            import time as _time
+
+            age = _time.time() - last_rb
+            if 0 <= age < 3600:
+                print(
+                    f"note: a generation rolled back {age:.0f}s ago "
+                    "(guardrail breach or operator action; "
+                    "/lifecycle.json has the reason)",
+                    file=sys.stderr,
+                )
     # device-efficiency surface (404/401-tolerant like quality): an ACTIVE
     # recompile storm is an operator-actionable warning — traffic is
     # churning shapes and every wave pays an XLA compile — but it does not
@@ -360,6 +393,7 @@ def do_deploy(args) -> int:
         max_queue=getattr(args, "max_queue", None),
         max_inflight=getattr(args, "max_inflight", None),
         default_deadline_s=getattr(args, "deadline_s", None),
+        enable_lifecycle=(True if getattr(args, "lifecycle", False) else None),
     )
     event_server = None
     if getattr(args, "event_port", None):
@@ -828,6 +862,85 @@ def do_quality(args) -> int:
     return _run_watched("pio quality", render_once, args.watch, args.watch_count)
 
 
+def _render_lifecycle_text(body: dict) -> str:
+    """Human one-screen rendering of a /lifecycle.json body."""
+    manifest = body.get("manifest") or {}
+    lines = [
+        f"engine: {manifest.get('engine', body.get('variant', '?'))}",
+        f"live generation: {manifest.get('live') or body.get('engineInstanceId', '-')}",
+    ]
+    if body.get("canary_in_progress"):
+        lines.append(
+            f"canary: {body.get('canary_instance')} "
+            f"({body.get('canary_fraction', 0):.0%} of traffic)"
+        )
+    else:
+        lines.append("canary: none")
+    controller = body.get("controller") or {}
+    lines.append(f"controller: {'enabled' if controller.get('enabled') else 'disabled'}")
+    last = controller.get("last_event")
+    if last:
+        lines.append(
+            f"last event: {last.get('event')} "
+            + " ".join(
+                f"{k}={v}" for k, v in sorted(last.items())
+                if k not in ("event", "at")
+            )
+        )
+    gens = manifest.get("generations") or []
+    if gens:
+        lines.append("generations (oldest first):")
+        for g in gens:
+            mark = {"live": "*", "canary": "~"}.get(g.get("status"), " ")
+            lines.append(
+                f" {mark} {g.get('instance_id')} {g.get('status'):<11} "
+                f"checksum {str(g.get('checksum'))[:12]}…"
+            )
+    return "\n".join(lines)
+
+
+def do_lifecycle(args) -> int:
+    """`pio lifecycle`: model-lifecycle state — generation manifest, canary
+    rollout, controller events.
+
+    With ``--url``, reads a running prediction server's ``/lifecycle.json``;
+    without it, reads the generation manifest straight from the configured
+    MODELDATA store for the given engine coordinates.
+    """
+
+    def render_once() -> None:
+        if args.url:
+            body = json.loads(
+                _fetch_url(
+                    args.url.rstrip("/") + "/lifecycle.json",
+                    getattr(args, "access_key", None),
+                )
+            )
+        else:
+            from predictionio_tpu.lifecycle.generations import GenerationStore
+
+            store = GenerationStore(
+                get_storage().models(),
+                args.engine_id,
+                args.engine_version,
+                args.variant,
+            )
+            body = {
+                "manifest": store.snapshot(),
+                "controller": {"enabled": False},
+                "canary_in_progress": store.canary() is not None,
+            }
+        print(
+            json.dumps(body, indent=2)
+            if args.json
+            else _render_lifecycle_text(body)
+        )
+
+    return _run_watched(
+        "pio lifecycle", render_once, args.watch, args.watch_count
+    )
+
+
 def do_check(args) -> int:
     """`pio check`: JAX-aware static analysis + DASE contract pre-flight.
 
@@ -1149,6 +1262,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="micro-batch queue bound; excess queries shed with 503 + "
         "Retry-After (PIO_MAX_QUEUE; default 1024, 0 = unbounded)",
     )
+    dp.add_argument(
+        "--lifecycle",
+        action="store_true",
+        help="run the closed-loop model-lifecycle controller: drift or "
+        "staleness triggers a warm-start retrain, the result canaries on "
+        "an entity-hash traffic fraction, and guardrails auto-promote or "
+        "auto-roll-back (PIO_LIFECYCLE=1; knobs via PIO_CANARY_* / "
+        "PIO_LIFECYCLE_* — see docs/robustness.md#model-lifecycle)",
+    )
     dp.set_defaults(fn=do_deploy)
 
     ud = sub.add_parser("undeploy")
@@ -1311,6 +1433,43 @@ def build_parser() -> argparse.ArgumentParser:
         help=argparse.SUPPRESS,  # bounded --watch iterations (tests)
     )
     ql.set_defaults(fn=do_quality)
+
+    lcp = sub.add_parser(
+        "lifecycle",
+        description="Model-lifecycle state: the generation manifest "
+        "(staged/canary/live/rolled_back with blob checksums), the canary "
+        "rollout in progress (if any), and the controller's last event — "
+        "from a running server's /lifecycle.json or the MODELDATA store.",
+    )
+    lcp.add_argument(
+        "--url", help="read a running server (e.g. http://127.0.0.1:8000)"
+    )
+    lcp.add_argument("--engine-id", default="default")
+    lcp.add_argument("--engine-version", default="default")
+    lcp.add_argument("--variant", default="default")
+    lcp.add_argument(
+        "--json", action="store_true",
+        help="raw /lifecycle.json instead of the text summary",
+    )
+    lcp.add_argument(
+        "--access-key",
+        default=None,
+        help="access key for key-gated servers (sent as a Bearer header)",
+    )
+    lcp.add_argument(
+        "--watch",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="re-render every SECONDS until interrupted",
+    )
+    lcp.add_argument(
+        "--watch-count",
+        type=int,
+        default=None,
+        help=argparse.SUPPRESS,  # bounded --watch iterations (tests)
+    )
+    lcp.set_defaults(fn=do_lifecycle)
 
     ck = sub.add_parser(
         "check",

@@ -1,0 +1,48 @@
+"""Fixture: PIO-RES003 — direct writes to final persistence paths."""
+
+import json
+import os
+from pathlib import Path
+
+
+def insert_bad(root: Path, key: str, blob: bytes):
+    final = root / f"{key}.bin"
+    final.write_bytes(blob)  # line 10: RES003 (no tmp + rename)
+
+
+def write_meta_bad(path: Path, n: int):
+    path.write_text(json.dumps({"n": n}))  # line 14: RES003
+
+
+def write_open_bad(path, rows):
+    with open(path, "w") as f:  # line 18: RES003 (open for write)
+        f.write("\n".join(rows))
+
+
+def insert_good(root: Path, key: str, blob: bytes):
+    final = root / f"{key}.bin"
+    tmp = final.with_suffix(".tmp")
+    tmp.write_bytes(blob)  # clean: committed by the replace below
+    os.replace(tmp, final)
+
+
+def read_only(path: Path) -> bytes:
+    with open(path, "rb") as f:  # clean: read mode
+        return f.read()
+
+
+def append_log_good(path, line):
+    tmp = Path(str(path) + ".tmp")
+    with tmp.open("w") as f:  # clean: tmp then rename
+        f.write(line)
+    tmp.rename(path)
+
+
+def insert_sneaky_bad(root: Path, key: str, blob: bytes):
+    safe = key.replace("/", "_")  # str.replace is NOT a rename commit
+    (root / safe).write_bytes(blob)  # RES003
+
+
+def write_path_open_bad(path: Path, text: str):
+    with path.open("w") as f:  # RES003 (pathlib mode-first spelling)
+        f.write(text)

@@ -87,6 +87,23 @@ class TestRuleCorpus:
             ("PIO-RES002", 19, "high"),
         ]
 
+    def test_res003_direct_persistence_write(self):
+        assert triples("res003_storage_write.py") == [
+            ("PIO-RES003", 10, "medium"),
+            ("PIO-RES003", 14, "medium"),
+            ("PIO-RES003", 18, "medium"),
+            # str.replace() in the same function is NOT a rename commit
+            ("PIO-RES003", 43, "medium"),
+            # pathlib's mode-first Path.open("w") spelling
+            ("PIO-RES003", 47, "medium"),
+        ]
+
+    def test_res003_scoped_to_storage_modules(self):
+        """The same direct write OUTSIDE a storage-pathed module is not a
+        persistence path and stays clean."""
+        src = (FIXTURES / "res003_storage_write.py").read_text()
+        assert analyze_source(src, "some_module.py") == []
+
     def test_every_shipped_rule_has_fixture_coverage(self):
         """The corpus exercises every registered AST rule."""
         seen = {
@@ -102,6 +119,7 @@ class TestRuleCorpus:
                 "conc003_lock.py",
                 "res001_timeout.py",
                 "res002_swallow.py",
+                "res003_storage_write.py",
             )
             for f in findings_for(name)
         }

@@ -113,6 +113,32 @@ def test_quality_module_lint_clean_with_zero_pragmas():
     assert baselined == []
 
 
+def test_lifecycle_modules_lint_clean_with_zero_pragmas():
+    """The model-lifecycle package (generation store, canary, controller)
+    decides what model serves production traffic: it must be `pio
+    check`-clean — including the new PIO-RES003 direct-persistence-write
+    rule — with NO pragma suppressions and NO baseline entries."""
+    report = analyze_paths([PACKAGE / "lifecycle"], root=REPO_ROOT)
+    assert report.errors == []
+    assert report.findings == [], "\n".join(f.text() for f in report.findings)
+    assert report.pragma_suppressed == 0
+    baselined = [
+        e
+        for e in Baseline.load(BASELINE).entries
+        if e.file.startswith("predictionio_tpu/lifecycle/")
+    ]
+    assert baselined == []
+
+
+def test_storage_modules_satisfy_res003():
+    """Every data/storage backend honors the tmp-write + atomic-rename
+    contract (PIO-RES003) with zero pragmas — the crash-safety floor the
+    lifecycle generation manifest is built on."""
+    report = analyze_paths([PACKAGE / "data" / "storage"], root=REPO_ROOT)
+    res003 = [f for f in report.findings if f.rule == "PIO-RES003"]
+    assert res003 == [], "\n".join(f.text() for f in res003)
+
+
 def test_device_module_lint_clean_with_zero_pragmas():
     """The device-efficiency module runs on the serving hot path (wave
     timeline marks, signature accounting per wave) and is imported by every
