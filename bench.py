@@ -733,6 +733,137 @@ def serving_p50_concurrent(model, num_users, clients=32, per_client=40):
         os.unlink(blob_path)
 
 
+_SHARDED_SCRIPT = r"""
+# Sharded scaling section worker: a FRESH interpreter with an N-virtual-
+# device CPU mesh (or the real accelerator mesh when one exists), so the
+# parent's platform/flags never constrain the sharded run.  Trains ALS on
+# the N-device data mesh (sharded factor state), binds the factor tables
+# model-parallel through a ShardPlan, serves waves through the sharded
+# top-k kernel, and prints ONE json line of timings + per-device bytes.
+import json, os, sys, time
+import numpy as np
+import jax
+
+n_dev = int(sys.argv[1])
+scale = float(sys.argv[2])
+
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.models.recommendation.engine import (
+    ALSAlgorithm, ALSAlgorithmParams, ALSModel, Query,
+)
+from predictionio_tpu.ops.als import ALSParams, train_als
+from predictionio_tpu.parallel.mesh import MeshConfig, make_mesh
+from predictionio_tpu.parallel.placement import LAST_KERNEL_SHAPES
+
+assert len(jax.devices()) >= n_dev, (len(jax.devices()), n_dev)
+nu = max(int(20000 * scale), 512)
+ni = max(int(4000 * scale), 256)
+nnz = max(int(400000 * scale), 20000)
+rng = np.random.default_rng(7)
+ui = rng.integers(0, nu, nnz).astype(np.int32)
+ii = rng.integers(0, ni, nnz).astype(np.int32)
+r = np.clip(rng.normal(3.5, 1.0, nnz), 0.5, 5.0).astype(np.float32)
+p = ALSParams(rank=16, num_iterations=10, chunk_size=1 << 14)
+mesh = make_mesh(MeshConfig(axes={"data": n_dev}), devices=jax.devices()[:n_dev])
+
+t0 = time.perf_counter()
+state = train_als(ui, ii, r, nu, ni, p, mesh=mesh)
+jax.block_until_ready(state.user_factors)
+train_s = time.perf_counter() - t0
+
+# bind the tables model-parallel and serve sharded waves
+uv = BiMap.from_keys(np.array([f"u{i}" for i in range(nu)]))
+iv = BiMap.from_keys(np.array([f"i{i}" for i in range(ni)]))
+algo = ALSAlgorithm(ALSAlgorithmParams(rank=16, shard_serving=True))
+blob = algo.make_persistent_model(
+    None, ALSModel(np.asarray(state.user_factors),
+                   np.asarray(state.item_factors), uv, iv))
+model = algo.load_persistent_model(None, blob)
+if model.shards is not None and len(jax.devices()) > n_dev:
+    # the host exposes MORE devices than --devices N (pre-set virtual-device
+    # flag, real multi-chip slice): load binds the whole mesh, so rebind onto
+    # exactly the first N or every sharded_* metric is mislabeled
+    from predictionio_tpu.parallel.placement import ShardPlan, bind_shards
+    model.shards = bind_shards(
+        ShardPlan.from_dict(blob["shard_plan"]),
+        {"user_factors": blob["user_factors"],
+         "item_factors": blob["item_factors"]},
+        devices=jax.devices()[:n_dev],
+    )
+attr = model.shards.attribution() if model.shards is not None else {}
+
+queries = [(q, Query(user=f"u{q % nu}", num=10)) for q in range(32)]
+algo.batch_predict(model, queries)  # compile
+lats = []
+for _ in range(30):
+    t0 = time.perf_counter()
+    algo.batch_predict(model, queries)
+    lats.append((time.perf_counter() - t0) * 1000)
+lats.sort()
+print(json.dumps({
+    "devices": n_dev,
+    "platform": jax.devices()[0].platform,
+    "nnz": nnz, "num_users": nu, "num_items": ni,
+    "train_s": round(train_s, 3),
+    "wave32_p50_ms": round(lats[len(lats) // 2], 3),
+    "wave32_p99_ms": round(lats[int(len(lats) * 0.99)], 3),
+    "per_device_factor_bytes": {
+        d: e["bytes"] for d, e in sorted(attr.items())},
+    "kernel_shapes": LAST_KERNEL_SHAPES.get("als.sharded_topk"),
+}))
+"""
+
+
+def bench_sharded_section(n_devices: int, scale: float) -> dict:
+    """`python bench.py --devices N`: the N-device scaling section.
+
+    Runs in a subprocess so the virtual-device flag (CPU hosts) applies at
+    backend init; on a real multi-device accelerator the flag is left
+    alone and the worker binds the first N devices.
+    """
+    import subprocess
+
+    import jax
+
+    env = dict(os.environ)
+    # probe the ACTUAL backend, not the XLA_FLAGS string: on a real
+    # accelerator host with >= N devices the worker inherits the env as-is
+    # and binds the first N real chips; only a CPU-backed parent (or one
+    # with too few accelerators) gets the virtual-device flag
+    accel = [d for d in jax.devices() if d.platform != "cpu"]
+    if len(accel) < n_devices:
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+        env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", _SHARDED_SCRIPT, str(n_devices), str(scale)],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    lines = proc.stdout.strip().splitlines()
+    if proc.returncode != 0:
+        # XLA background threads occasionally abort at interpreter exit
+        # ("terminate called without an active exception") AFTER the worker
+        # printed its result line — the measurements are complete, only the
+        # teardown crashed, so accept a fully-emitted result
+        try:
+            res = json.loads(lines[-1]) if lines else None
+        except ValueError:
+            res = None
+        if isinstance(res, dict) and "wave32_p99_ms" in res:
+            return res
+        raise RuntimeError(
+            f"sharded section worker failed: {proc.stderr[-1000:]}"
+        )
+    return json.loads(lines[-1])
+
+
 def main() -> None:
     import types
 
@@ -1199,6 +1330,30 @@ def main() -> None:
             f"p99_concurrent32={p99_conc:.3f}ms (target <10ms)"
         )
 
+    # --devices N: the sharded scaling section (model-parallel serving +
+    # data-parallel train over an N-device mesh; subprocess-isolated)
+    shard_devices = 0
+    if "--devices" in sys.argv:
+        shard_devices = int(sys.argv[sys.argv.index("--devices") + 1])
+
+    def sec_sharded():
+        res = bench_sharded_section(
+            shard_devices,
+            float(os.environ.get("PIO_BENCH_SHARD_SCALE", min(scale, 0.05))),
+        )
+        metrics["sharded_devices"] = res["devices"]
+        metrics["sharded_train_s"] = res["train_s"]
+        metrics["sharded_serving_p50_ms"] = res["wave32_p50_ms"]
+        metrics["sharded_serving_p99_ms"] = res["wave32_p99_ms"]
+        metrics["sharded"] = res
+        per_dev = res.get("per_device_factor_bytes") or {}
+        log(
+            f"# sharded devices={res['devices']} train={res['train_s']:.2f}s "
+            f"wave32 p50={res['wave32_p50_ms']:.2f}ms "
+            f"p99={res['wave32_p99_ms']:.2f}ms "
+            f"per-device factor bytes={sorted(set(per_dev.values()))}"
+        )
+
     if run_section("data", sec_data):
         run_section("als_train", sec_als_train)
         run_section("als_rank32", sec_als_rank32)
@@ -1212,6 +1367,8 @@ def main() -> None:
         else:
             failed.append("als_serving")
             log("# SECTION als_serving SKIPPED: no trained ALS state")
+    if shard_devices > 1:
+        run_section("sharded", sec_sharded)
 
     from predictionio_tpu.obs.device import BENCH_SCHEMA_VERSION
 

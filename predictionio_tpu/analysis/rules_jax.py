@@ -282,6 +282,54 @@ class JitConstructionInLoop(Rule):
                     break
 
 
+#: calls that (re)place data onto devices — correct at bind/load time, a
+#: per-wave resharding hazard inside a serving loop body (each call pays a
+#: host->device transfer AND may re-lay-out a sharded array every wave)
+_PLACEMENT_CALLS = frozenset(("jax.device_put",))
+_PLACEMENT_SUFFIXES = (".global_data_array", ".shard_put", ".bind_shards")
+_PLACEMENT_NAMES = frozenset(
+    ("global_data_array", "shard_put", "bind_shards")
+)
+
+
+@rule
+class ReshardInHotLoop(Rule):
+    """PIO-JAX006: device placement inside a hot-path loop body."""
+
+    id = "PIO-JAX006"
+    severity = Severity.MEDIUM
+    summary = (
+        "jax.device_put/global_data_array inside a predict/batch_fn loop "
+        "body; placement belongs at model bind time, not per wave"
+    )
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        seen: set[int] = set()
+        for fn in _hot_functions(mod):
+            for loop in walk_skipping_defs(fn.body):
+                if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                    continue
+                for node in walk_skipping_defs(loop.body + loop.orelse):
+                    if not isinstance(node, ast.Call) or id(node) in seen:
+                        continue
+                    callee = resolve_call(mod, node)
+                    if (
+                        callee in _PLACEMENT_CALLS
+                        or callee in _PLACEMENT_NAMES
+                        or callee.endswith(_PLACEMENT_SUFFIXES)
+                    ):
+                        seen.add(id(node))
+                        yield self.finding(
+                            mod,
+                            node,
+                            f"{callee}(...) inside a loop body of hot-path "
+                            f"function {fn.name!r}: every iteration pays a "
+                            "host->device transfer and may re-shard the "
+                            "array per wave; place arrays once at model "
+                            "bind/load time and reuse the device copies",
+                        )
+
+
 @rule
 class JitMutableDefault(Rule):
     """PIO-JAX005: jitted function with a mutable (unhashable) default arg."""

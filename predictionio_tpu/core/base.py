@@ -154,6 +154,16 @@ class Algorithm(abc.ABC, Generic[PD, M, Q, PR]):
         """
         return [(i, self.predict(model, q)) for i, q in queries]
 
+    # -- sharding hook (parallel/placement.py) -------------------------------
+    def serving_shard_plan(self, model: M) -> Any:
+        """The ShardPlan this model should serve under, or None for
+        single-device serving.  Algorithms with sharded serving paths
+        (ALS/NCF factor tables) return a ``parallel.placement.ShardPlan``;
+        ``run_train`` records it beside the checkpoint and the lifecycle
+        generation manifest embeds it, so ``deploy`` can re-bind the layout
+        onto the serving host's mesh."""
+        return None
+
     # -- persistence hooks (controller/PersistentModel.scala) ---------------
     def make_persistent_model(self, ctx: EngineContext, model: M) -> Any:
         """Convert the trained model into its checkpointable form.

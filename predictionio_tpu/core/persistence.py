@@ -121,9 +121,18 @@ def deserialize_models_sharded(
     return _ShardingUnpickler(io.BytesIO(manifest), get_part).load()
 
 
-def save_models(models_store, instance_id: str, models: list[Any]) -> None:
-    """Persist a model list under an engine-instance id (sharded format)."""
-    manifest, parts = serialize_models_sharded(models)
+def save_models(
+    models_store, instance_id: str, models: list[Any],
+    threshold: int | None = None,
+) -> None:
+    """Persist a model list under an engine-instance id (sharded format).
+
+    ``threshold`` overrides ``PART_THRESHOLD`` (read at call time, so tests
+    and deployments can lower it to force factor tables into named parts —
+    the layout the lifecycle per-part checksums verify shard-by-shard)."""
+    manifest, parts = serialize_models_sharded(
+        models, threshold if threshold is not None else PART_THRESHOLD
+    )
     models_store.insert_parts(instance_id, manifest, parts)
 
 

@@ -177,6 +177,11 @@ def run_train(
             # become individual parts instead of one monolithic pickle blob
             with trace("train.persist.save_models"):
                 save_models(storage.models(), instance.id, stored)
+            # record the serving ShardPlan (if any algorithm declares one)
+            # as a tiny sidecar blob: GenerationStore.record embeds it in
+            # the manifest WITHOUT unpickling the whole model, and deploy
+            # re-binds it onto the serving mesh
+            _record_shard_plan(storage, instance.id, algos, models)
         done = instance.completed()
         instances.update(done)
         breakdown = _stage_breakdown(root, _compile_seconds() - compile_s0)
@@ -208,6 +213,46 @@ def run_train(
         from predictionio_tpu.core.cleanup import run as _run_cleanups
 
         _run_cleanups()
+
+
+#: storage-key suffix for the serving-layout sidecar blob (kept OUTSIDE the
+#: checksummed model bytes: the manifest entry is the authoritative copy)
+SHARD_PLAN_SUFFIX = ":shardplan"
+
+
+def _record_shard_plan(storage, instance_id: str, algos, models) -> None:
+    """Persist the first algorithm-declared ShardPlan for this instance.
+    Best-effort bookkeeping — a failure here must never fail the train."""
+    try:
+        plan = next(
+            (
+                p
+                for a, m in zip(algos, models)
+                for p in [getattr(a, "serving_shard_plan", lambda _m: None)(m)]
+                if p is not None
+            ),
+            None,
+        )
+        if plan is None:
+            return
+        storage.models().insert(
+            f"{instance_id}{SHARD_PLAN_SUFFIX}",
+            json.dumps(plan.to_dict(), sort_keys=True).encode("utf-8"),
+        )
+    except Exception as e:  # pragma: no cover - defensive
+        log.warning("could not record shard plan for %s: %s", instance_id, e)
+
+
+def read_shard_plan(models_store, instance_id: str) -> dict | None:
+    """The recorded serving layout of one trained instance (dict form), or
+    None when the model is unsharded / predates plans."""
+    raw = models_store.get(f"{instance_id}{SHARD_PLAN_SUFFIX}")
+    if raw is None:
+        return None
+    try:
+        return json.loads(raw.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None
 
 
 def run_fake(

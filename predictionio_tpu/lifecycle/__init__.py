@@ -20,6 +20,7 @@ from predictionio_tpu.lifecycle.generations import (
     GenerationStore,
     LifecycleError,
     compute_checksum,
+    compute_checksums,
 )
 
 __all__ = [
@@ -34,6 +35,7 @@ __all__ = [
     "LifecycleError",
     "LifecyclePolicy",
     "compute_checksum",
+    "compute_checksums",
     "default_retrain",
     "in_canary_fraction",
 ]

@@ -85,6 +85,14 @@ class Generation:
     promoted_at: float | None = None
     rolled_back_at: float | None = None
     note: str = ""
+    #: per-part SHA-256 over the sharded-checkpoint layout ("manifest" +
+    #: one entry per named part) — verify() pinpoints WHICH factor shard
+    #: went bad instead of just "bytes differ"; None for legacy single-blob
+    part_checksums: dict[str, str] | None = None
+    #: the serving ShardPlan (parallel.placement.ShardPlan.to_dict()) this
+    #: generation was trained to serve under; deploy re-binds it onto the
+    #: current mesh (re-sharding on device-count mismatch)
+    shard_plan: dict[str, Any] | None = None
 
     def to_dict(self) -> dict[str, Any]:
         return asdict(self)
@@ -95,9 +103,19 @@ class Generation:
         return cls(**{k: v for k, v in d.items() if k in known})
 
 
-def compute_checksum(models_store: Models, instance_id: str) -> str:
-    """SHA-256 over the stored bytes of one engine instance's model, in
-    either layout (sharded manifest + parts, or the legacy single blob).
+def compute_checksums(
+    models_store: Models, instance_id: str
+) -> tuple[str, dict[str, str] | None]:
+    """One pass over the stored bytes of an engine instance's model:
+    ``(whole_checksum, part_checksums)``.
+
+    The whole checksum is SHA-256 over either layout (sharded manifest +
+    parts, or the legacy single blob).  For the sharded layout the second
+    element maps ``{"manifest": ..., "part:<name>": ...}`` to per-blob
+    digests (one corrupt factor shard is named, not just detected); for the
+    single-blob layout it is None.  Each blob is fetched ONCE — a multi-GB
+    sharded checkpoint on a remote backend is not downloaded twice just to
+    produce both granularities.
 
     Reads go through the ``models.read`` fault seam so chaos plans can
     corrupt bytes deterministically between write and verify.
@@ -107,6 +125,7 @@ def compute_checksum(models_store: Models, instance_id: str) -> str:
     if manifest is not None:
         h.update(b"manifest\x00")
         h.update(manifest)
+        parts = {"manifest": hashlib.sha256(manifest).hexdigest()}
         for name in sorted(_manifest_part_names(manifest)):
             part = _read_blob(models_store, f"{instance_id}:part:{name}")
             if part is None:
@@ -115,13 +134,27 @@ def compute_checksum(models_store: Models, instance_id: str) -> str:
                 )
             h.update(name.encode() + b"\x00")
             h.update(part)
-        return h.hexdigest()
+            parts[f"part:{name}"] = hashlib.sha256(part).hexdigest()
+        return h.hexdigest(), parts
     blob = _read_blob(models_store, instance_id)
     if blob is None:
         raise CorruptModelError(f"no model bytes for instance {instance_id}")
     h.update(b"blob\x00")
     h.update(blob)
-    return h.hexdigest()
+    return h.hexdigest(), None
+
+
+def compute_checksum(models_store: Models, instance_id: str) -> str:
+    """Whole-model SHA-256 (either layout); see :func:`compute_checksums`."""
+    return compute_checksums(models_store, instance_id)[0]
+
+
+def compute_part_checksums(
+    models_store: Models, instance_id: str
+) -> dict[str, str] | None:
+    """Per-part SHA-256 of a sharded checkpoint, or None for the legacy
+    single-blob layout; see :func:`compute_checksums`."""
+    return compute_checksums(models_store, instance_id)[1]
 
 
 def _read_blob(models_store: Models, key: str) -> bytes | None:
@@ -241,15 +274,27 @@ class GenerationStore:
         status: str = STAGED,
         checksum: str | None = None,
         note: str = "",
+        shard_plan: dict[str, Any] | None = None,
     ) -> Generation:
         """Add (or re-checksum) a generation.  Computes the blob checksum
         when not given — the staging step that makes later corruption
-        detectable."""
+        detectable.  Sharded checkpoints additionally record PER-PART
+        checksums (one corrupt factor shard is named, not just detected),
+        and the generation embeds the model's ShardPlan (given explicitly
+        or read from the ``run_train`` sidecar) so the manifest is the
+        durable record of how a sharded model was laid out."""
         if status not in STATUSES:
             raise LifecycleError(f"unknown generation status {status!r}")
         with self._lock:
+            part_checksums = None
             if checksum is None:
-                checksum = compute_checksum(self.models_store, instance_id)
+                checksum, part_checksums = compute_checksums(
+                    self.models_store, instance_id
+                )
+            if shard_plan is None:
+                from predictionio_tpu.core.workflow import read_shard_plan
+
+                shard_plan = read_shard_plan(self.models_store, instance_id)
             manifest = self.read()
             now = _now()
             entry = Generation(
@@ -258,6 +303,8 @@ class GenerationStore:
                 status=status,
                 created_at=now,
                 promoted_at=now if status == LIVE else None,
+                part_checksums=part_checksums,
+                shard_plan=shard_plan,
             )
             if note:
                 entry.note = note
@@ -353,7 +400,11 @@ class GenerationStore:
 
     def verify(self, gen: Generation | str) -> None:
         """Recompute the stored-bytes checksum and compare; raises
-        :class:`CorruptModelError` on mismatch or missing bytes."""
+        :class:`CorruptModelError` on mismatch or missing bytes.
+
+        Generations recorded with per-part checksums verify part-by-part,
+        so ONE corrupt factor shard is reported BY NAME (and still trips
+        the same last-good fallback walk at bind time)."""
         if isinstance(gen, str):
             found = self.get(gen)
             if found is None:
@@ -361,6 +412,25 @@ class GenerationStore:
                     f"generation {gen} not in manifest {self.engine_key}"
                 )
             gen = found
+        if gen.part_checksums:
+            actual_parts = compute_part_checksums(
+                self.models_store, gen.instance_id
+            )
+            if actual_parts is not None:
+                bad = sorted(
+                    set(gen.part_checksums.items())
+                    ^ set(actual_parts.items())
+                )
+                bad_names = sorted({name for name, _ in bad})
+                if bad_names:
+                    raise CorruptModelError(
+                        f"model shards {bad_names} of generation "
+                        f"{gen.instance_id} do not match their manifest "
+                        "checksums — refusing to serve a corrupt model"
+                    )
+                return
+            # layout changed under the manifest (sharded -> single blob):
+            # fall through to the whole-bytes comparison below
         actual = compute_checksum(self.models_store, gen.instance_id)
         if actual != gen.checksum:
             raise CorruptModelError(
@@ -385,10 +455,11 @@ class GenerationStore:
     def snapshot(self) -> dict[str, Any]:
         """The /lifecycle.json manifest half."""
         manifest = self.read()
-        live = canary = None
+        live = canary = live_plan = None
         for g in manifest["generations"]:
             if g["status"] == LIVE:
                 live = g["instance_id"]
+                live_plan = g.get("shard_plan")
             elif g["status"] == CANARY:
                 canary = g["instance_id"]
         return {
@@ -396,6 +467,9 @@ class GenerationStore:
             "schema": manifest.get("schema", SCHEMA_VERSION),
             "live": live,
             "canary": canary,
+            # the live generation's serving layout (mesh axes + per-array
+            # specs) — what `pio status`/the dashboard show as "mesh shape"
+            "shard_plan": live_plan,
             "generations": manifest["generations"],
             **self.rollback_stats(),
         }

@@ -59,6 +59,11 @@ class NCFAlgorithmParams:
     weight_decay: float = 0.0  # AdamW decoupled decay (0 = plain Adam)
     #: iALS confidence weight (loss="wals" and the "als" pretrainer)
     alpha: float = 2.0
+    #: serve the embedding tables factor-sharded over the mesh ``model``
+    #: axis (ShardPlan recorded in the persisted model + generation
+    #: manifest; re-bound by deploy).  The MLP head stays replicated; each
+    #: device scores only its item rows and shards exchange k winners.
+    shard_serving: bool = False
     #: "" (random init) or "als": pretrain the GMF tables with implicit
     #: ALS (rank = embed_dim, exact alternating solves — seconds on the
     #: pallas path) before SGD fine-tuning.  The NCF paper's §3.4.1
@@ -81,6 +86,7 @@ class NCFAlgorithmParams:
         "negPower": "neg_power",
         "itemBias": "item_bias",
         "weightDecay": "weight_decay",
+        "shardServing": "shard_serving",
     }
 
     def __post_init__(self):
@@ -182,6 +188,9 @@ class NCFModel:
     state: NCFState
     user_vocab: BiMap
     item_vocab: BiMap
+    #: factor-sharded serving state (parallel.placement.BoundShards) when a
+    #: ShardPlan was re-bound at deploy; None = single-device serving
+    shards: Any = None
 
     def sanity_check(self):
         leaf = np.asarray(self.state.params["user_emb"])
@@ -341,6 +350,95 @@ class NCFAlgorithm(Algorithm):
             out.extend(self._predict_wave(model, iq[c0 : c0 + self.MAX_WAVE]))
         return out
 
+    # -- sharded serving (parallel.placement) --------------------------------
+
+    def serving_shard_plan(self, model: NCFModel):
+        """Embedding tables (and the per-item bias) row-sharded over the
+        ``model`` axis; the MLP head replicates.  Recorded in the persisted
+        model + generation manifest; deploy re-binds it."""
+        if not self.params.shard_serving:
+            return None
+        from predictionio_tpu.parallel.placement import ShardPlan
+
+        sharded = ["user_emb", "item_emb"]
+        ndims = {}
+        if model.state.params.get("item_bias") is not None:
+            sharded.append("item_bias")
+            ndims["item_bias"] = 1
+        return ShardPlan.model_parallel(
+            sharded,
+            rows={
+                "user_emb": len(model.user_vocab),
+                "item_emb": len(model.item_vocab),
+                "item_bias": len(model.item_vocab),
+            },
+            ndims=ndims,
+        )
+
+    def _sharded_packed_topk(self, model: NCFModel, padded, n_items, k, b):
+        """The sharded wave kernel: collective user-row lookup from the
+        sharded user table, then per-shard MLP scoring over ONLY the item
+        rows each device owns + k-winner merge (no device ever builds a
+        [B, n_items] score row — per-shard shapes are recorded in
+        ``placement.LAST_KERNEL_SHAPES['ncf.sharded_topk']``)."""
+        from predictionio_tpu.ops.ncf import score_users_vs_items
+        from predictionio_tpu.parallel.placement import (
+            build_sharded_topk,
+            gather_rows,
+            run_observed_wave,
+        )
+
+        bound = model.shards
+        sig = (b, k, n_items, bound.n_shards) + tuple(
+            bound.arrays["user_emb"].shape
+        )
+        has_bias = bound.arrays.get("item_bias") is not None
+        head = {
+            n: bound.arrays[n]
+            for n in ("mlp", "out_w", "out_b")
+            if n in bound.arrays
+        }
+
+        def build():
+            if has_bias:
+                local = lambda item_emb, item_bias, h, q: (  # noqa: E731
+                    score_users_vs_items(h, q, item_emb, item_bias)
+                )
+                names = ["item_emb", "item_bias", "__head__"]
+            else:
+                local = lambda item_emb, h, q: (  # noqa: E731
+                    score_users_vs_items(h, q, item_emb, None)
+                )
+                names = ["item_emb", "__head__"]
+            return build_sharded_topk(
+                bound.mesh, bound.plan, local, names,
+                n_items=n_items, k=k, name="ncf.sharded_topk",
+            )
+
+        kernel = bound.kernel((b, k), build)
+        args = (bound.arrays["item_emb"],) + (
+            (bound.arrays["item_bias"],) if has_bias else ()
+        )
+
+        def compute(users_dev):
+            q_rows = gather_rows(
+                bound.mesh, bound.arrays["user_emb"], users_dev
+            )
+            packed_dev = kernel(*args, head, q_rows)
+            return packed_dev, args + (head, q_rows)
+
+        return run_observed_wave(
+            "ncf.sharded_topk",
+            kernel=kernel,
+            sig=sig,
+            host_input=padded,
+            compute=compute,
+            shard_arrays={
+                n: bound.arrays[n] for n in bound.plan.specs
+                if bound.arrays.get(n) is not None
+            },
+        )
+
     def _predict_wave(self, model: NCFModel, iq):
         if not iq:
             return []
@@ -358,49 +456,52 @@ class NCFAlgorithm(Algorithm):
             b = max(1 << (len(iq) - 1).bit_length(), 32)
             padded = np.zeros(b, np.int32)
             padded[: len(iq)] = np.maximum(uidx, 0)
-        # shapes past the padding menu still compile (a client sweeping
-        # `num` walks k through every power of two): account every
-        # signature so churn shows up as a recompile storm, not a mystery.
-        # The table shape is part of the key — two deployed models must not
-        # share cost/compile entries.
-        eff = device_obs.default_efficiency()
-        sig = (b, k, n_items) + tuple(
-            model.state.params["user_emb"].shape
-        )
-        device_obs.default_recompiles().note_signature(
-            "ncf.batch_predict", sig
-        )
-        with device_obs.wave_stage("h2d"):
-            users_dev = jnp.asarray(padded)
-            device_obs.note_transfer("h2d", padded.nbytes)
-        # deferred: the AOT cost-analysis compile runs on a daemon thread,
-        # concurrent with the jit cache's own compile of this signature —
-        # never inside the wave's deadline
-        eff.capture_cost(
-            "ncf.batch_predict",
-            _score_topk_batch,
-            model.state.params,
-            users_dev,
-            n_items,
-            k,
-            signature=sig,
-            defer=True,
-        )
-        t_dev = time.perf_counter()
-        with device_obs.wave_stage("compute"):
-            packed_dev = _score_topk_batch(
-                model.state.params, users_dev, n_items, k
+        if model.shards is not None:
+            packed = self._sharded_packed_topk(model, padded, n_items, k, b)
+        else:
+            # shapes past the padding menu still compile (a client sweeping
+            # `num` walks k through every power of two): account every
+            # signature so churn shows up as a recompile storm, not a
+            # mystery.  The table shape is part of the key — two deployed
+            # models must not share cost/compile entries.
+            eff = device_obs.default_efficiency()
+            sig = (b, k, n_items) + tuple(
+                model.state.params["user_emb"].shape
             )
-            packed_dev.block_until_ready()
-        compute_s = time.perf_counter() - t_dev
-        device_obs.note_wave_device(device_obs.device_label(packed_dev))
-        device_obs.note_wave_cost(
-            "ncf.batch_predict", eff.cached_cost("ncf.batch_predict", sig)
-        )
-        with device_obs.wave_stage("d2h"):
-            packed = np.asarray(packed_dev)
-            device_obs.note_transfer("d2h", packed.nbytes)
-        eff.observe("ncf.batch_predict", compute_s, signature=sig)
+            device_obs.default_recompiles().note_signature(
+                "ncf.batch_predict", sig
+            )
+            with device_obs.wave_stage("h2d"):
+                users_dev = jnp.asarray(padded)
+                device_obs.note_transfer("h2d", padded.nbytes)
+            # deferred: the AOT cost-analysis compile runs on a daemon
+            # thread, concurrent with the jit cache's own compile of this
+            # signature — never inside the wave's deadline
+            eff.capture_cost(
+                "ncf.batch_predict",
+                _score_topk_batch,
+                model.state.params,
+                users_dev,
+                n_items,
+                k,
+                signature=sig,
+                defer=True,
+            )
+            t_dev = time.perf_counter()
+            with device_obs.wave_stage("compute"):
+                packed_dev = _score_topk_batch(
+                    model.state.params, users_dev, n_items, k
+                )
+                packed_dev.block_until_ready()
+            compute_s = time.perf_counter() - t_dev
+            device_obs.note_wave_device(device_obs.device_label(packed_dev))
+            device_obs.note_wave_cost(
+                "ncf.batch_predict", eff.cached_cost("ncf.batch_predict", sig)
+            )
+            with device_obs.wave_stage("d2h"):
+                packed = np.asarray(packed_dev)
+                device_obs.note_transfer("d2h", packed.nbytes)
+            eff.observe("ncf.batch_predict", compute_s, signature=sig)
         top_s = packed[0]
         top_i = packed[1].astype(np.int64)
         out = []
@@ -428,7 +529,7 @@ class NCFAlgorithm(Algorithm):
         return out
 
     def make_persistent_model(self, ctx: EngineContext, model: NCFModel):
-        return {
+        out = {
             "params": jax.tree_util.tree_map(
                 lambda x: np.asarray(jax.device_get(x)), model.state.params
             ),
@@ -438,6 +539,10 @@ class NCFAlgorithm(Algorithm):
             "user_vocab": model.user_vocab.to_state(),
             "item_vocab": model.item_vocab.to_state(),
         }
+        plan = self.serving_shard_plan(model)
+        if plan is not None:
+            out["shard_plan"] = plan.to_dict()
+        return out
 
     def load_persistent_model(self, ctx: EngineContext, data) -> NCFModel:
         params = data["params"]
@@ -455,6 +560,37 @@ class NCFAlgorithm(Algorithm):
                 "out_w": params["out_w"],
                 "out_b": params["out_b"],
             }
+        from predictionio_tpu.parallel.placement import (
+            ShardPlan,
+            bind_shards,
+        )
+
+        plan = ShardPlan.from_dict(data.get("shard_plan"))
+        if plan is not None and len(jax.devices()) > 1:
+            # re-bind the recorded layout onto the CURRENT mesh: tables
+            # shard, the MLP head replicates.  ``state.params`` stays a
+            # HOST pytree (solo path + sanity checks); the sharded device
+            # copies live in ``shards``.
+            host = jax.tree_util.tree_map(np.asarray, params)
+            shards = bind_shards(plan, host)
+            from predictionio_tpu.parallel.mesh import meter_shards
+
+            meter_shards(
+                "ncf.serving_tables",
+                {n: shards.arrays[n] for n in plan.specs
+                 if shards.arrays.get(n) is not None},
+            )
+            return NCFModel(
+                state=NCFState(
+                    params=host,
+                    n_users=data["n_users"],
+                    n_items=data["n_items"],
+                    config=data["config"],
+                ),
+                user_vocab=BiMap.from_state(data["user_vocab"]),
+                item_vocab=BiMap.from_state(data["item_vocab"]),
+                shards=shards,
+            )
         return NCFModel(
             state=NCFState(
                 params=jax.tree_util.tree_map(jnp.asarray, params),

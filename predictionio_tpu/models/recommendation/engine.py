@@ -216,9 +216,19 @@ class ALSAlgorithmParams:
     reg: float = 0.01
     seed: int = 3
     chunk_size: int = 1 << 19
+    #: serve the item table factor-sharded over the mesh ``model`` axis:
+    #: the persisted model records a ShardPlan, ``deploy`` re-binds it onto
+    #: the serving host's devices, and batch waves run the sharded top-k
+    #: (per-device partial top-k + k-winner merge — no device ever holds a
+    #: full-catalog score row).  Single-device hosts ignore the plan.
+    shard_serving: bool = False
 
     # reference engine.json spellings (customize-serving/engine.json:14-21)
-    params_aliases = {"lambda": "reg", "numIterations": "num_iterations"}
+    params_aliases = {
+        "lambda": "reg",
+        "numIterations": "num_iterations",
+        "shardServing": "shard_serving",
+    }
 
 
 @dataclass
@@ -229,6 +239,9 @@ class ALSModel:
     item_factors: Any  # [num_items, rank]
     user_vocab: BiMap
     item_vocab: BiMap
+    #: factor-sharded serving state (parallel.placement.BoundShards) when a
+    #: ShardPlan was re-bound at deploy; None = single-device serving
+    shards: Any = None
 
     def sanity_check(self):
         uf = np.asarray(self.user_factors)
@@ -251,6 +264,7 @@ class ALSModel:
     def __getstate__(self):
         d = dict(self.__dict__)
         d.pop("_host_cache", None)
+        d["shards"] = None  # device placement never rides in a pickle
         return d
 
 
@@ -341,6 +355,78 @@ class ALSAlgorithm(Algorithm):
             )
         )
 
+    # -- sharded serving (parallel.placement) --------------------------------
+
+    def serving_shard_plan(self, model: ALSModel):
+        """The declarative layout serving re-binds at deploy: both factor
+        tables row-sharded over the ``model`` axis (recorded in the
+        persisted model AND the generation manifest)."""
+        if not self.params.shard_serving:
+            return None
+        from predictionio_tpu.parallel.placement import ShardPlan
+
+        return ShardPlan.model_parallel(
+            ["user_factors", "item_factors"],
+            rows={
+                "user_factors": len(model.user_vocab),
+                "item_factors": len(model.item_vocab),
+            },
+        )
+
+    def _sharded_topk(self, model: ALSModel, uidx: np.ndarray, k: int):
+        """One wave through the factor-sharded kernel: gather the user rows
+        (collective lookup from the sharded user table), per-shard partial
+        top-k over each device's item rows, k-winner merge.  Shapes are
+        padded to the same power-of-two menu as the NCF wave path so client
+        ``num`` sweeps cannot storm the compile cache."""
+        from predictionio_tpu.parallel.placement import (
+            build_sharded_topk,
+            gather_rows,
+            run_observed_wave,
+        )
+
+        bound = model.shards
+        n_items = len(model.item_vocab)
+        with device_obs.wave_stage("host_gather"):
+            b = max(1 << (len(uidx) - 1).bit_length(), 8)
+            k_pad = min(max(1 << (k - 1).bit_length(), 16), n_items)
+            padded = np.zeros(b, np.int32)
+            padded[: len(uidx)] = uidx
+        sig = (b, k_pad, n_items, bound.n_shards) + tuple(
+            bound.arrays["item_factors"].shape
+        )
+        kernel = bound.kernel(
+            (b, k_pad),
+            lambda: build_sharded_topk(
+                bound.mesh,
+                bound.plan,
+                lambda item_local, q: q @ item_local.T,
+                ["item_factors"],
+                n_items=n_items,
+                k=k_pad,
+                name="als.sharded_topk",
+            ),
+        )
+
+        def compute(uidx_dev):
+            q_rows = gather_rows(
+                bound.mesh, bound.arrays["user_factors"], uidx_dev
+            )
+            packed_dev = kernel(bound.arrays["item_factors"], q_rows)
+            return packed_dev, (bound.arrays["item_factors"], q_rows)
+
+        packed = run_observed_wave(
+            "als.sharded_topk",
+            kernel=kernel,
+            sig=sig,
+            host_input=padded,
+            compute=compute,
+            shard_arrays={
+                n: bound.arrays[n] for n in ("user_factors", "item_factors")
+            },
+        )
+        return packed[0], packed[1].astype(np.int64)
+
     #: waves below this go through the host replica (latency-bound micro-
     #: batches); at/above it the one [B, rank] x [rank, n_items] device
     #: matmul wins (throughput-bound eval batches)
@@ -358,7 +444,9 @@ class ALSAlgorithm(Algorithm):
         if rows:
             uidx = np.asarray([u for _, u, _ in rows], np.int32)
             k = max(min(q.num, len(model.item_vocab)) for _, _, q in rows)
-            if len(rows) >= self.DEVICE_BATCH_MIN:
+            if model.shards is not None:
+                top_s, top_i = self._sharded_topk(model, uidx, k)
+            elif len(rows) >= self.DEVICE_BATCH_MIN:
                 eff = device_obs.default_efficiency()
                 with device_obs.wave_stage("h2d"):
                     # count the bytes that actually cross: numpy factors
@@ -422,14 +510,45 @@ class ALSAlgorithm(Algorithm):
 
     # -- persistence ---------------------------------------------------------
     def make_persistent_model(self, ctx: EngineContext, model: ALSModel):
-        return {
+        out = {
             "user_factors": np.asarray(jax.device_get(model.user_factors)),
             "item_factors": np.asarray(jax.device_get(model.item_factors)),
             "user_vocab": model.user_vocab.to_state(),
             "item_vocab": model.item_vocab.to_state(),
         }
+        plan = self.serving_shard_plan(model)
+        if plan is not None:
+            # the model carries its own layout: deploy re-binds this plan
+            # onto whatever mesh the serving host has
+            out["shard_plan"] = plan.to_dict()
+        return out
 
     def load_persistent_model(self, ctx: EngineContext, data) -> ALSModel:
+        from predictionio_tpu.parallel.placement import (
+            ShardPlan,
+            bind_shards,
+        )
+
+        plan = ShardPlan.from_dict(data.get("shard_plan"))
+        if plan is not None and len(jax.devices()) > 1:
+            # re-bind the recorded layout onto the CURRENT mesh (re-sharding
+            # on device-count mismatch); the unsharded host copies stay for
+            # the solo-query path and sanity checks
+            Uh = np.asarray(data["user_factors"])
+            Vh = np.asarray(data["item_factors"])
+            model = ALSModel(
+                user_factors=Uh,
+                item_factors=Vh,
+                user_vocab=BiMap.from_state(data["user_vocab"]),
+                item_vocab=BiMap.from_state(data["item_vocab"]),
+                shards=bind_shards(
+                    plan, {"user_factors": Uh, "item_factors": Vh}
+                ),
+            )
+            from predictionio_tpu.parallel.mesh import meter_shards
+
+            meter_shards("als.serving_factors", model.shards.arrays)
+            return model
         return ALSModel(
             user_factors=jnp.asarray(data["user_factors"]),
             item_factors=jnp.asarray(data["item_factors"]),

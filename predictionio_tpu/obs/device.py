@@ -602,7 +602,9 @@ WAVE_STAGES: tuple[str, ...] = ("host_gather", "h2d", "compute", "d2h")
 class WaveTimeline:
     """Per-wave accumulator engines mark stages into (contextvar-scoped)."""
 
-    __slots__ = ("stages", "device", "fn", "flops", "bytes", "transfers")
+    __slots__ = (
+        "stages", "device", "fn", "flops", "bytes", "transfers", "shards",
+    )
 
     def __init__(self):
         self.stages: dict[str, float] = {}
@@ -611,6 +613,9 @@ class WaveTimeline:
         self.flops: float = 0.0
         self.bytes: float = 0.0
         self.transfers: dict[str, float] = {}
+        #: per-device byte/shard attribution of a SHARDED wave (filled by
+        #: note_wave_shards; flows into per-item meta -> flight entries)
+        self.shards: dict[str, dict[str, float]] = {}
 
 
 _timeline_var: contextvars.ContextVar[WaveTimeline | None] = (
@@ -670,6 +675,16 @@ def note_wave_cost(fn: str, cost: Mapping[str, float] | None) -> None:
         if cost:
             tl.flops = float(cost.get("flops", 0.0))
             tl.bytes = float(cost.get("bytes", 0.0))
+
+
+def note_wave_shards(attribution: Mapping[str, Mapping[str, float]]) -> None:
+    """Attach a sharded wave's per-device attribution (the
+    ``parallel.mesh.meter_shards`` map) to the current timeline: every
+    flight entry of a sharded wave answers "which devices participated and
+    how many bytes each held"."""
+    tl = _timeline_var.get()
+    if tl is not None and attribution:
+        tl.shards = {k: dict(v) for k, v in attribution.items()}
 
 
 def note_transfer(
@@ -756,10 +771,12 @@ def als_plan_roofline(plan: Mapping[str, Any]) -> dict[str, float] | None:
 # ---------------------------------------------------------------------------
 # bench schema + perf-regression gate
 
-#: BENCH json schema: introduced in the round that moved the roofline math
-#: here; ``pio bench --compare`` refuses version-less or older files (their
-#: metrics predate the utilization fields and the gate semantics).
-BENCH_SCHEMA_VERSION = 2
+#: BENCH json schema: v2 introduced the roofline/utilization fields and the
+#: compare gate; v3 adds the ``--devices N`` sharded section (flat
+#: ``sharded_*`` metrics + the ``sharded_devices`` config echo the gate
+#: refuses to cross-compare).  ``pio bench --compare`` refuses version-less
+#: or older files.
+BENCH_SCHEMA_VERSION = 3
 
 #: regression-gateable BENCH metrics and which direction is better.  Only
 #: keys present in BOTH files are compared; everything else (configuration
@@ -787,6 +804,10 @@ BENCH_GATE_METRICS: dict[str, str] = {
     "ncf_epochs_per_s": "higher",
     "roofline_achieved_gb_s": "higher",
     "roofline_achieved_tflop_s": "higher",
+    # sharded section (bench --devices N): lower is better
+    "sharded_train_s": "lower",
+    "sharded_serving_p50_ms": "lower",
+    "sharded_serving_p99_ms": "lower",
 }
 
 
@@ -826,6 +847,18 @@ def compare_bench(
         report["error"] = (
             f"bench configurations differ: current metric={cur_metric!r} "
             f"vs previous {prev_metric!r} — these runs are not comparable"
+        )
+        return 2, report
+    # sharded-section config: an 8-device sharded run gated against a
+    # 2-device file would "regress" by construction — refuse, like the
+    # scale-suffix check above (absent-on-both means no sharded section ran)
+    cur_dev = current.get("sharded_devices")
+    prev_dev = previous.get("sharded_devices")
+    if cur_dev != prev_dev:
+        report["error"] = (
+            f"sharded sections differ: current sharded_devices={cur_dev!r} "
+            f"vs previous {prev_dev!r} — re-run bench with the same "
+            "--devices to compare"
         )
         return 2, report
     for key in sorted(BENCH_GATE_METRICS):
@@ -875,17 +908,42 @@ def default_recompiles() -> RecompileTracker:
     return RECOMPILES
 
 
+def shard_snapshot(registry: MetricsRegistry | None = None) -> dict[str, Any]:
+    """Per-device shard attribution as recorded by
+    ``parallel.mesh.meter_shards``: ``{fn: {device: {bytes, waves,
+    seconds}}}`` plus the participating-device list (the "mesh shape" an
+    operator sees).  Empty when nothing sharded has run."""
+    reg = registry or REGISTRY
+    out: dict[str, dict[str, dict[str, float]]] = {}
+    fam_bytes = reg.get("pio_shard_bytes")
+    if fam_bytes is not None:
+        for (fn, device), child in fam_bytes.series():
+            out.setdefault(fn, {})[device] = {
+                "bytes": float(getattr(child, "value", 0.0))
+            }
+    fam_secs = reg.get("pio_shard_seconds")
+    if fam_secs is not None:
+        for (fn, device), child in fam_secs.series():
+            entry = out.setdefault(fn, {}).setdefault(device, {})
+            entry["waves"] = int(getattr(child, "count", 0))
+            entry["seconds"] = round(float(getattr(child, "sum", 0.0)), 6)
+    devices = sorted({d for per_fn in out.values() for d in per_fn})
+    return {"devices": devices, "functions": out}
+
+
 def device_snapshot(
     efficiency: EfficiencyTracker | None = None,
     recompiles: RecompileTracker | None = None,
 ) -> dict[str, Any]:
     """The ``GET /efficiency.json`` body: achieved-vs-peak per entry point,
-    recompile accounting (with any active storm), and transfer tallies."""
+    recompile accounting (with any active storm), transfer tallies, and the
+    per-device shard attribution of any sharded model."""
     snap = (efficiency or DEVICE_EFFICIENCY).snapshot()
     snap["recompiles"] = (recompiles or RECOMPILES).snapshot()
     snap["transfers"] = {
         f"{k}_bytes": v for k, v in transfer_totals().items()
     }
+    snap["shards"] = shard_snapshot()
     return snap
 
 

@@ -231,8 +231,15 @@ def _half_step(
     num_seg_pad: int,
     p: ALSParams,
     axis: str | None,
+    gather_output: bool = True,
 ):
-    """One alternating update: recompute factors for ``seg`` entities."""
+    """One alternating update: recompute factors for ``seg`` entities.
+
+    ``gather_output=False`` returns each device's OWN solved slice instead
+    of all-gathering to a replicated table — the sharded-state training
+    layout, where factors persist 1/n_dev per device between iterations and
+    only the transient all-gather inside the NEXT half-step materializes a
+    full table."""
     a_weight, rhs = confidence_weights(
         rating, valid, p.implicit_prefs, p.alpha, other_factors.dtype
     )
@@ -259,7 +266,7 @@ def _half_step(
     b = acc[:, k * k : k * k + k]
     counts = acc[:, -1]
     x = _solve_factors(A, b, counts, p.reg, p.scale_reg_with_count, gram)
-    if axis:
+    if axis and gather_output:
         return jax.lax.all_gather(x, axis, axis=0, tiled=True)
     return x
 
@@ -658,13 +665,24 @@ def _record_pallas_efficiency(wall_s: float, p: ALSParams) -> None:
     )
 
 
-def _make_train_step(mesh: Mesh | None, num_users_pad, num_items_pad, p: ALSParams):
-    """Build (or fetch) the jitted one-iteration function."""
+def _make_train_step(
+    mesh: Mesh | None, num_users_pad, num_items_pad, p: ALSParams,
+    shard_state: bool = False,
+):
+    """Build (or fetch) the jitted one-iteration function.
+
+    ``shard_state=True`` (the single-controller mesh path) keeps the factor
+    tables row-sharded over the ``data`` axis BETWEEN iterations — per-device
+    persistent factor HBM drops 1/n_dev as devices grow, and only a
+    transient all-gather inside each half-step materializes the full
+    opposite table for the COO gathers.  The solved slices, psums, and
+    per-device solves are identical either way, so the numerics match the
+    replicated layout bit-for-bit."""
     key = (
         mesh,  # jax.sharding.Mesh is hashable (None for single device)
         num_users_pad, num_items_pad,
         p.rank, p.reg, p.implicit_prefs, p.alpha,
-        p.scale_reg_with_count, p.chunk_size,
+        p.scale_reg_with_count, p.chunk_size, shard_state,
     )
     cached = _STEP_CACHE.get(key)
     if cached is not None:
@@ -674,6 +692,16 @@ def _make_train_step(mesh: Mesh | None, num_users_pad, num_items_pad, p: ALSPara
 
     def step(u_idx, i_idx, rating, valid, U, V):
         axis = "data" if mesh is not None else None
+        if shard_state and axis:
+            # factors arrive as this device's row slice: gather the full
+            # opposite table transiently, return only the solved slice
+            Vf = jax.lax.all_gather(V, axis, axis=0, tiled=True)
+            U = _half_step(u_idx, i_idx, rating, valid, Vf, num_users_pad,
+                           p, axis, gather_output=False)
+            Uf = jax.lax.all_gather(U, axis, axis=0, tiled=True)
+            V = _half_step(i_idx, u_idx, rating, valid, Uf, num_items_pad,
+                           p, axis, gather_output=False)
+            return U, V
         U = _half_step(u_idx, i_idx, rating, valid, V, num_users_pad, p, axis)
         V = _half_step(i_idx, u_idx, rating, valid, U, num_items_pad, p, axis)
         return U, V
@@ -685,14 +713,17 @@ def _make_train_step(mesh: Mesh | None, num_users_pad, num_items_pad, p: ALSPara
 
         coo_spec = PSpec("data")
         repl = PSpec(None, None)
-        # check=False: outputs are all_gather'ed, hence replicated in
-        # value, but the static vma/rep analysis cannot prove it.
+        factor_spec = PSpec("data", None) if shard_state else repl
+        # check=False: replicated outputs are all_gather'ed values the
+        # static vma/rep analysis cannot prove (sharded outputs are fine
+        # either way).
         fn = jax.jit(
             shard_map_compat(
                 step,
                 mesh=mesh,
-                in_specs=(coo_spec, coo_spec, coo_spec, coo_spec, repl, repl),
-                out_specs=(repl, repl),
+                in_specs=(coo_spec, coo_spec, coo_spec, coo_spec,
+                          factor_spec, factor_spec),
+                out_specs=(factor_spec, factor_spec),
                 check=False,
             )
         )
@@ -831,15 +862,21 @@ def train_als(
 
     if mesh is not None:
         coo_sh = NamedSharding(mesh, PSpec("data"))
-        repl_sh = NamedSharding(mesh, PSpec(None, None))
+        # sharded factor state (ROADMAP item 1): the tables and everything
+        # derived from them persist row-sharded over the mesh, so the
+        # per-device factor footprint drops as devices grow — each step
+        # all-gathers the opposite table transiently for its COO gathers
+        factor_sh = NamedSharding(mesh, PSpec("data", None))
         u = jax.device_put(u, coo_sh)
         i = jax.device_put(i, coo_sh)
         r = jax.device_put(r, coo_sh)
         valid = jax.device_put(valid, coo_sh)
-        U0 = jax.device_put(U0, repl_sh)
-        V0 = jax.device_put(V0, repl_sh)
+        U0 = jax.device_put(U0, factor_sh)
+        V0 = jax.device_put(V0, factor_sh)
 
-    step = _make_train_step(mesh, num_users_pad, num_items_pad, p)
+    step = _make_train_step(
+        mesh, num_users_pad, num_items_pad, p, shard_state=mesh is not None
+    )
     import time as _time
 
     from predictionio_tpu.obs import device as device_obs
@@ -877,4 +914,9 @@ def train_als(
     # extends (ROADMAP item 1) — which device holds how many factor bytes,
     # and what the solve spent per device of wall clock
     meter_shards("als.factors", (U, V), seconds=wall_s)
+    # NOTE: the un-padding slice below re-lays-out the result (uneven row
+    # counts cannot stay P("data")-sharded); the sharded-state win is the
+    # LOOP, where factors + normal-equation state persist 1/n_dev per
+    # device across all num_iterations steps (metered just above).  Serving
+    # re-shards from the host checkpoint via its own ShardPlan.
     return ALSState(user_factors=U[:num_users], item_factors=V[:num_items])

@@ -188,6 +188,43 @@ def score_all_items(params: dict, user_idx: jax.Array) -> jax.Array:
     return score
 
 
+def score_users_vs_items(
+    head: dict, ue: jax.Array, item_emb: jax.Array, item_bias=None
+) -> jax.Array:
+    """``[B, 2d|d]`` user rows against an item-table BLOCK: ``[B, rows]``.
+
+    The building block of factor-sharded serving: inside the sharded top-k
+    kernel each device calls this with ONLY the item rows it owns (and the
+    replicated MLP ``head``), so no device ever holds a full-catalog score
+    row.  Same math as :func:`score_all_items` restricted to a row block —
+    the per-row computation is identical, so sharded and unsharded serving
+    score identically.  ``head`` carries ``mlp``/``out_w``/``out_b`` (and
+    discriminates pure GMF by the absence of ``out_w``, as everywhere).
+    """
+    if "out_w" not in head:  # pure GMF (mlp_layers=())
+        scores = ue @ item_emb.T + head["out_b"][0]
+        if item_bias is not None:
+            scores = scores + item_bias[None, :]
+        return scores
+    d = ue.shape[-1] // 2
+    b, rows = ue.shape[0], item_emb.shape[0]
+    gmf = ue[:, None, :d] * item_emb[None, :, :d]  # [B, rows, d]
+    h = jnp.concatenate(
+        [
+            jnp.broadcast_to(ue[:, None, d:], (b, rows, d)),
+            jnp.broadcast_to(item_emb[None, :, d:], (b, rows, d)),
+        ],
+        axis=-1,
+    )
+    for layer in head["mlp"]:
+        h = jax.nn.relu(h @ layer["w"] + layer["b"])
+    fused = jnp.concatenate([gmf, h], axis=-1)
+    scores = (fused @ head["out_w"] + head["out_b"])[..., 0]
+    if item_bias is not None:
+        scores = scores + item_bias[None, :]
+    return scores
+
+
 def bpr_loss(params: dict, user_idx, pos_idx, neg_idx, valid) -> jax.Array:
     """Bayesian Personalized Ranking over K negatives: mean over pairs of
     -log sigmoid(s_pos - s_neg).  ``neg_idx`` is [b, K]."""

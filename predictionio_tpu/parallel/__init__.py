@@ -3,5 +3,27 @@ from predictionio_tpu.parallel.mesh import (
     default_mesh,
     make_mesh,
 )
+from predictionio_tpu.parallel.placement import (
+    BoundShards,
+    ShardPlan,
+    ShardPlanError,
+    bind_shards,
+    build_sharded_topk,
+    gather_rows,
+    replicate,
+    shard_put,
+)
 
-__all__ = ["MeshConfig", "default_mesh", "make_mesh"]
+__all__ = [
+    "MeshConfig",
+    "default_mesh",
+    "make_mesh",
+    "BoundShards",
+    "ShardPlan",
+    "ShardPlanError",
+    "bind_shards",
+    "build_sharded_topk",
+    "gather_rows",
+    "replicate",
+    "shard_put",
+]

@@ -175,8 +175,22 @@ def balance_local_chunks(
     returns the padded arrays plus a float32 valid-mask (1.0 real rows) —
     the same weight-0-padding trick train_als uses, so padding rows are
     mathematically inert.
+
+    The remainder-on-last-host case — one process read fewer (possibly
+    zero) rows than its peers — is exactly what the all-gathered target
+    handles: every process pads to the SAME chunk-aligned length, and the
+    short host's extra padding carries valid=0.
     """
+    if multiple <= 0:
+        raise ValueError(f"multiple must be positive, got {multiple}")
+    if not arrays:
+        raise ValueError("balance_local_chunks needs at least one array")
     n_local = len(arrays[0])
+    if any(len(a) != n_local for a in arrays):
+        raise ValueError(
+            "balance_local_chunks arrays must share one local length, got "
+            f"{[len(a) for a in arrays]}"
+        )
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
@@ -288,9 +302,16 @@ def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0, fill=0):
 
     Returns (padded, original_size).  Static-shape-friendly: callers mask with
     the original size inside jit instead of slicing dynamically.
+
+    An EMPTY axis still pads up to one full multiple (each shard must own a
+    non-empty equal slice; size 0 reports 0 real rows), and a non-positive
+    ``multiple`` is a caller bug surfaced loudly — under sharding these are
+    load-bearing, not degenerate, cases.
     """
+    if multiple <= 0:
+        raise ValueError(f"multiple must be positive, got {multiple}")
     size = arr.shape[axis]
-    target = ((size + multiple - 1) // multiple) * multiple
+    target = max(((size + multiple - 1) // multiple) * multiple, multiple)
     if target == size:
         return arr, size
     pad_widths = [(0, 0)] * arr.ndim

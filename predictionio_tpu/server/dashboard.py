@@ -163,6 +163,27 @@ def _efficiency_html(registry: MetricsRegistry) -> str:
         if storms
         else ""
     )
+    shards = snap.get("shards") or {}
+    shard_rows = []
+    for fn, per_dev in sorted(shards.get("functions", {}).items()):
+        for device, entry in sorted(per_dev.items()):
+            shard_rows.append(
+                f"<tr><td>{html.escape(fn)}</td>"
+                f"<td>{html.escape(device)}</td>"
+                f"<td>{entry.get('bytes', 0.0):.0f}</td>"
+                f"<td>{entry.get('waves', 0)}</td>"
+                f"<td>{entry.get('seconds', 0.0):.4f}</td></tr>"
+            )
+    shard_html = (
+        "<h3>Mesh shards</h3><p>mesh: "
+        + html.escape(", ".join(shards.get("devices", [])))
+        + "</p><table border='1'><tr><th>fn</th><th>device</th>"
+        "<th>bytes</th><th>waves</th><th>seconds</th></tr>"
+        + "".join(shard_rows)
+        + "</table>"
+        if shard_rows
+        else ""
+    )
     return (
         f"<h2>Device efficiency</h2><p>platform: "
         f"{html.escape(str(snap['platform']))}, peaks: "
@@ -174,6 +195,7 @@ def _efficiency_html(registry: MetricsRegistry) -> str:
         "<th>cost source</th><th>trend</th></tr>"
         + "".join(rows)
         + "</table>"
+        + shard_html
     )
 
 

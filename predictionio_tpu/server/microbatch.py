@@ -359,6 +359,9 @@ class MicroBatcher:
                         meta["wave_fn"] = timeline.fn
                         meta["wave_flops"] = timeline.flops
                         meta["wave_bytes"] = timeline.bytes
+                    if timeline.shards:
+                        # sharded wave: which devices held which bytes
+                        meta["wave_shards"] = timeline.shards
                     meta["wave_size"] = len(items)
                     meta["wave_seq"] = wave_seq
                     meta["wave_request_ids"] = rids
@@ -443,6 +446,8 @@ class MicroBatcher:
                     meta["wave_fn"] = timeline.fn
                     meta["wave_flops"] = timeline.flops
                     meta["wave_bytes"] = timeline.bytes
+                if timeline.shards:
+                    meta["wave_shards"] = timeline.shards
                 meta["wave_size"] = 1
                 meta["wave_seq"] = wave_seq
                 meta["solo_retry"] = True

@@ -149,6 +149,10 @@ def _status_remote(
                 "rolled_back"
             ),
         }
+        plan = (lifecycle.get("manifest") or {}).get("shard_plan")
+        if plan:
+            # the live generation serves sharded: show the recorded layout
+            report["lifecycle"]["shard_plan_axes"] = plan.get("axes")
         if lifecycle.get("canary_in_progress"):
             print(
                 "WARNING: canary rollout in progress "
@@ -180,6 +184,12 @@ def _status_remote(
             "active_recompile_storms": storms,
             "peaks": efficiency.get("peaks"),
         }
+        shards = efficiency.get("shards") or {}
+        if shards.get("devices"):
+            # sharded serving/training has run: mesh participants + the
+            # per-device byte/wave attribution (per-device utilization)
+            report["efficiency"]["mesh_devices"] = shards["devices"]
+            report["efficiency"]["shards"] = shards.get("functions")
         for fn, storm in storms.items():
             print(
                 f"WARNING: recompile storm active for {fn} "

@@ -1,0 +1,30 @@
+"""PIO-JAX006 fixture: per-wave device placement inside serving loops."""
+import jax
+
+from predictionio_tpu.parallel.mesh import global_data_array
+
+
+def batch_predict(model, queries):
+    out = []
+    for i, q in queries:
+        table = jax.device_put(model.table)  # placed EVERY iteration
+        out.append((i, table))
+    return out
+
+
+def _serve_wave(payloads):
+    while payloads:
+        chunk = global_data_array(None, payloads.pop())  # re-sharded per wave
+    return chunk
+
+
+def predict(model, query):
+    # placement OUTSIDE a loop is the bind-time pattern: clean
+    table = jax.device_put(model.table)
+    return table[query]
+
+
+def helper(model, queries):
+    # not a hot-path function name: loops here are not serving waves
+    for q in queries:
+        jax.device_put(q)

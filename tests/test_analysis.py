@@ -60,6 +60,12 @@ class TestRuleCorpus:
     def test_jax005_mutable_default(self):
         assert triples("jax005_default.py") == [("PIO-JAX005", 7, "medium")]
 
+    def test_jax006_reshard_in_hot_loop(self):
+        assert triples("jax006_reshard.py") == [
+            ("PIO-JAX006", 10, "medium"),
+            ("PIO-JAX006", 17, "medium"),
+        ]
+
     def test_conc001_blocking_in_async(self):
         assert triples("conc001_async.py") == [
             ("PIO-CONC001", 9, "high"),
@@ -114,6 +120,7 @@ class TestRuleCorpus:
                 "jax003_branch.py",
                 "jax004_loop.py",
                 "jax005_default.py",
+                "jax006_reshard.py",
                 "conc001_async.py",
                 "conc002_poll.py",
                 "conc003_lock.py",

@@ -165,18 +165,23 @@ def test_bench_compare_smoke():
 
     from predictionio_tpu.tools.cli import main
 
+    from predictionio_tpu.obs.device import BENCH_SCHEMA_VERSION
+
     with tempfile.TemporaryDirectory() as tmp:
         prev = Path(tmp) / "prev.json"
         cur = Path(tmp) / "cur.json"
         prev.write_text(
-            json.dumps({"schema_version": 2, "value": 5.0}) + "\n"
+            json.dumps({"schema_version": BENCH_SCHEMA_VERSION, "value": 5.0})
+            + "\n"
         )
         cur.write_text(
-            json.dumps({"schema_version": 2, "value": 8.0}) + "\n"
+            json.dumps({"schema_version": BENCH_SCHEMA_VERSION, "value": 8.0})
+            + "\n"
         )
         assert main(["bench", "--compare", str(prev), str(cur)]) == 1
         cur.write_text(
-            json.dumps({"schema_version": 2, "value": 5.1}) + "\n"
+            json.dumps({"schema_version": BENCH_SCHEMA_VERSION, "value": 5.1})
+            + "\n"
         )
         assert main(["bench", "--compare", str(prev), str(cur)]) == 0
 
