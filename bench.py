@@ -751,11 +751,19 @@ from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.models.recommendation.engine import (
     ALSAlgorithm, ALSAlgorithmParams, ALSModel, Query,
 )
+from predictionio_tpu.obs.disttrace import set_process_name
+from predictionio_tpu.obs.logging import set_request_context
+from predictionio_tpu.obs.timeline import collect_trace
 from predictionio_tpu.ops.als import ALSParams, train_als
 from predictionio_tpu.parallel.mesh import MeshConfig, make_mesh
 from predictionio_tpu.parallel.placement import LAST_KERNEL_SHAPES
 
 assert len(jax.devices()) >= n_dev, (len(jax.devices()), n_dev)
+# opt into the per-iteration training track and bind a trace id for it —
+# the step-timeline fragments this worker folds into its result line
+os.environ["PIO_TRAIN_STEP_TIMELINE"] = "1"
+set_process_name("bench-sharded")
+set_request_context("benchsteps", "benchsteps")
 nu = max(int(20000 * scale), 512)
 ni = max(int(4000 * scale), 256)
 nnz = max(int(400000 * scale), 20000)
@@ -800,6 +808,17 @@ for _ in range(30):
     algo.batch_predict(model, queries)
     lats.append((time.perf_counter() - t0) * 1000)
 lats.sort()
+# the training step timeline: every als.train_step[i] fragment the traced
+# mesh train emitted, rendered as Chrome trace-event JSON (Perfetto-loadable)
+try:
+    tl = collect_trace("benchsteps", include_local=True)
+    step_timeline = {
+        "steps": sum(1 for x in tl.nodes.values()
+                     if x.name.startswith("als.train_step")),
+        "chrome_trace": tl.to_chrome_trace(),
+    }
+except Exception as e:
+    step_timeline = {"steps": 0, "error": str(e)}
 print(json.dumps({
     "devices": n_dev,
     "platform": jax.devices()[0].platform,
@@ -810,6 +829,7 @@ print(json.dumps({
     "per_device_factor_bytes": {
         d: e["bytes"] for d, e in sorted(attr.items())},
     "kernel_shapes": LAST_KERNEL_SHAPES.get("als.sharded_topk"),
+    "step_timeline": step_timeline,
 }))
 """
 
@@ -1335,6 +1355,9 @@ def main() -> None:
     shard_devices = 0
     if "--devices" in sys.argv:
         shard_devices = int(sys.argv[sys.argv.index("--devices") + 1])
+    timeline_out = None
+    if "--timeline" in sys.argv:
+        timeline_out = sys.argv[sys.argv.index("--timeline") + 1]
 
     def sec_sharded():
         res = bench_sharded_section(
@@ -1353,6 +1376,16 @@ def main() -> None:
             f"p99={res['wave32_p99_ms']:.2f}ms "
             f"per-device factor bytes={sorted(set(per_dev.values()))}"
         )
+        # --timeline OUT.json: dump the per-iteration training step
+        # timeline (Chrome trace-event JSON, Perfetto-loadable)
+        tl = res.get("step_timeline") or {}
+        if timeline_out and tl.get("chrome_trace"):
+            with open(timeline_out, "w") as f:
+                json.dump(tl["chrome_trace"], f)
+            log(
+                f"# sharded step timeline: {tl.get('steps', 0)} training "
+                f"steps -> {timeline_out}"
+            )
 
     if run_section("data", sec_data):
         run_section("als_train", sec_als_train)

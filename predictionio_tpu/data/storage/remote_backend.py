@@ -42,7 +42,9 @@ from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.storage import base
 from predictionio_tpu.data.storage.base import EventFilter, EventFrame
 from predictionio_tpu.data.storage.frame_codec import decode_frame, encode_frame
+from predictionio_tpu.obs.disttrace import propagation_headers
 from predictionio_tpu.obs.logging import REQUEST_ID_HEADER, get_request_id
+from predictionio_tpu.obs.tracing import trace
 from predictionio_tpu.resilience import faults
 from predictionio_tpu.resilience.breaker import CircuitBreaker, CircuitOpen, get_breaker
 from predictionio_tpu.resilience.deadline import DeadlineExceeded, expired, remaining
@@ -302,40 +304,55 @@ class RemoteClient:
         if idempotent is None:
             idempotent = method in _IDEMPOTENT
         label = f"{method} {path.split('?')[0]}"
-        # deadline admission: no budget left means no call at all
-        rem = remaining()
-        if rem is not None and rem <= 0:
-            raise DeadlineExceeded(
-                f"storage call {label} abandoned: request deadline exceeded"
-            )
-        # circuit breaker: a dead daemon costs ~0 ms once open
-        br = self.breaker
-        if br is not None:
+        # the round trip runs under its own (unrecorded, ring-skipped) span
+        # so the assembled cross-process timeline shows storage time as a
+        # named lane entry with the daemon's spans parented UNDER it —
+        # without a storage call made off-request (worker threads, pollers)
+        # evicting real request traces from the recent-traces ring
+        with trace("storage.remote", record=False, ring=False) as sp:
+            sp.tags = {"call": label}
+            # ... and the span context rides next to the request id
+            # (X-Pio-Trace-Id + THIS span as X-Pio-Parent-Span), so the
+            # daemon's spans parent under the call site instead of
+            # orphaning (obs/disttrace.py)
+            headers.update(propagation_headers())
+            # deadline admission: no budget left means no call at all
+            rem = remaining()
+            if rem is not None and rem <= 0:
+                raise DeadlineExceeded(
+                    f"storage call {label} abandoned: request deadline "
+                    "exceeded"
+                )
+            # circuit breaker: a dead daemon costs ~0 ms once open
+            br = self.breaker
+            if br is not None:
+                try:
+                    br.guard(f"storage call {label}")
+                except CircuitOpen as e:
+                    raise StorageUnavailable(
+                        str(e), retry_after_s=e.retry_after_s
+                    ) from e
             try:
-                br.guard(f"storage call {label}")
-            except CircuitOpen as e:
-                raise StorageUnavailable(
-                    str(e), retry_after_s=e.retry_after_s
-                ) from e
-        try:
-            result = self._attempt(method, path, body, headers, idempotent, label)
-        except RemoteStorageError:
+                result = self._attempt(
+                    method, path, body, headers, idempotent, label
+                )
+            except RemoteStorageError:
+                if br is not None:
+                    br.record_failure()
+                raise
+            except BaseException:
+                # a deadline expiry (or anything non-transport) says nothing
+                # about the ENDPOINT's health: release a consumed half-open
+                # trial slot instead of leaking it, which would wedge the
+                # breaker half-open with no slots until process restart
+                if br is not None:
+                    br.release_trial()
+                raise
             if br is not None:
-                br.record_failure()
-            raise
-        except BaseException:
-            # a deadline expiry (or anything non-transport) says nothing
-            # about the ENDPOINT's health: release a consumed half-open
-            # trial slot instead of leaking it, which would wedge the
-            # breaker half-open with no slots until process restart
-            if br is not None:
-                br.release_trial()
-            raise
-        if br is not None:
-            br.record_success()
-        if self.retry_budget is not None:
-            self.retry_budget.record_call()
-        return result
+                br.record_success()
+            if self.retry_budget is not None:
+                self.retry_budget.record_call()
+            return result
 
     def _attempt(
         self,

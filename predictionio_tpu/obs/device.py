@@ -43,6 +43,7 @@ import contextlib
 import contextvars
 import logging
 import os
+import statistics
 import sys
 import threading
 import time
@@ -604,6 +605,7 @@ class WaveTimeline:
 
     __slots__ = (
         "stages", "device", "fn", "flops", "bytes", "transfers", "shards",
+        "shard_seconds",
     )
 
     def __init__(self):
@@ -616,6 +618,10 @@ class WaveTimeline:
         #: per-device byte/shard attribution of a SHARDED wave (filled by
         #: note_wave_shards; flows into per-item meta -> flight entries)
         self.shards: dict[str, dict[str, float]] = {}
+        #: per-device settle seconds of a SHARDED wave (filled by
+        #: note_shard_seconds; the straggler board's and the distributed
+        #: timeline's per-shard signal)
+        self.shard_seconds: dict[str, float] = {}
 
 
 _timeline_var: contextvars.ContextVar[WaveTimeline | None] = (
@@ -685,6 +691,15 @@ def note_wave_shards(attribution: Mapping[str, Mapping[str, float]]) -> None:
     tl = _timeline_var.get()
     if tl is not None and attribution:
         tl.shards = {k: dict(v) for k, v in attribution.items()}
+
+
+def note_shard_seconds(shard_seconds: Mapping[str, float]) -> None:
+    """Attach a sharded wave's per-device settle seconds to the current
+    timeline (flows into per-item meta as ``wave_shard_seconds`` and the
+    distributed timeline's per-shard device tracks)."""
+    tl = _timeline_var.get()
+    if tl is not None and shard_seconds:
+        tl.shard_seconds = {k: float(v) for k, v in shard_seconds.items()}
 
 
 def note_transfer(
@@ -891,6 +906,175 @@ def compare_bench(
 
 
 # ---------------------------------------------------------------------------
+# straggler & imbalance detection
+
+
+class StragglerBoard:
+    """Per-wave shard-time skew tracking and straggler attribution.
+
+    Every sharded wave reports its per-device settle seconds
+    (``placement.run_observed_wave`` measures them shard by shard); the
+    board computes the wave's **skew fraction** — ``max / median - 1`` over
+    the participating devices, 0.0 for a perfectly balanced wave — into
+    ``pio_shard_skew_frac{fn}``, keeps a rolling per-device scoreboard
+    (waves participated, waves slowest, cumulative seconds), and flags a
+    **straggler** when ONE device is the slowest with skew above
+    ``skew_threshold`` for ``patience`` consecutive waves (a single slow
+    wave is noise; the same device dragging every wave is a sick chip, a
+    co-tenant, or an imbalanced placement).  Byte imbalance
+    (``max / mean - 1`` over per-device bytes, from ``shard_attribution``)
+    rides along as ``pio_shard_bytes_imbalance_frac{fn}``.
+
+    Thresholds come from ``PIO_SHARD_SKEW_THRESHOLD`` (default 0.5: the
+    slowest shard runs 1.5x the median) and ``PIO_SHARD_SKEW_PATIENCE``
+    (default 3 consecutive waves).  ``snapshot`` is the ``/shards.json``
+    scoreboard body.
+    """
+
+    def __init__(
+        self,
+        registry: MetricsRegistry | None = None,
+        skew_threshold: float | None = None,
+        patience: int | None = None,
+    ):
+        if skew_threshold is None:
+            try:
+                skew_threshold = float(
+                    os.environ.get("PIO_SHARD_SKEW_THRESHOLD", "0.5")
+                )
+            except ValueError:
+                skew_threshold = 0.5
+        if patience is None:
+            try:
+                patience = int(os.environ.get("PIO_SHARD_SKEW_PATIENCE", "3"))
+            except ValueError:
+                patience = 3
+        self.skew_threshold = skew_threshold
+        self.patience = max(patience, 1)
+        self._lock = threading.Lock()
+        #: fn -> scoreboard state (all mutation under _lock)
+        self._fns: dict[str, dict[str, Any]] = {}
+        reg = registry or REGISTRY
+        self._g_skew = reg.gauge(
+            "pio_shard_skew_frac",
+            "Last sharded wave's max/median shard-time skew (0 = balanced)",
+            labelnames=("fn",),
+        )
+        self._g_bytes_imbalance = reg.gauge(
+            "pio_shard_bytes_imbalance_frac",
+            "Per-device bytes max/mean imbalance of a sharded array group",
+            labelnames=("fn",),
+        )
+        self._c_stragglers = reg.counter(
+            "pio_shard_straggler_total",
+            "Straggler flags raised (one device slowest past the skew "
+            "threshold for `patience` consecutive waves)",
+            labelnames=("fn", "device"),
+        )
+
+    def record_wave(
+        self,
+        fn: str,
+        shard_seconds: Mapping[str, float],
+        shard_bytes: Mapping[str, float] | None = None,
+    ) -> float:
+        """Record one sharded wave's per-device seconds (and optionally the
+        per-device byte attribution); returns the wave's skew fraction."""
+        secs = {str(k): float(v) for k, v in shard_seconds.items() if v >= 0}
+        if len(secs) < 2:
+            return 0.0
+        med = statistics.median(secs.values())
+        slowest = max(secs, key=secs.get)  # type: ignore[arg-type]
+        skew = (secs[slowest] / med - 1.0) if med > 0 else 0.0
+        breach = skew > self.skew_threshold
+        flagged = False
+        with self._lock:
+            entry = self._fns.setdefault(
+                fn,
+                {
+                    "waves": 0,
+                    "last_skew": 0.0,
+                    "last_max_device": None,
+                    "streak_device": None,
+                    "streak": 0,
+                    "straggler": None,
+                    "devices": {},
+                },
+            )
+            entry["waves"] += 1
+            entry["last_skew"] = round(skew, 6)
+            entry["last_max_device"] = slowest
+            for dev, s in secs.items():
+                d = entry["devices"].setdefault(
+                    dev, {"waves": 0, "slowest": 0, "seconds": 0.0}
+                )
+                d["waves"] += 1
+                d["seconds"] = round(d["seconds"] + s, 6)
+            entry["devices"][slowest]["slowest"] += 1
+            if breach:
+                if entry["streak_device"] == slowest:
+                    entry["streak"] += 1
+                else:
+                    entry["streak_device"] = slowest
+                    entry["streak"] = 1
+                if (
+                    entry["streak"] >= self.patience
+                    and entry["straggler"] != slowest
+                ):
+                    entry["straggler"] = slowest
+                    flagged = True
+            else:
+                entry["streak_device"] = None
+                entry["streak"] = 0
+                entry["straggler"] = None
+        self._g_skew.labels(fn).set(skew)
+        if shard_bytes:
+            vals = [float(v) for v in shard_bytes.values()]
+            mean = sum(vals) / len(vals) if vals else 0.0
+            imbalance = (max(vals) / mean - 1.0) if mean > 0 else 0.0
+            self._g_bytes_imbalance.labels(fn).set(imbalance)
+        if flagged:
+            self._c_stragglers.labels(fn, slowest).inc()
+            log.warning(
+                "shard straggler: device %s is the slowest shard of %s for "
+                "%d consecutive waves (skew %.0f%% over the median, "
+                "threshold %.0f%%) — check chip health / co-tenancy / "
+                "placement balance (/shards.json has the scoreboard)",
+                slowest,
+                fn,
+                self.patience,
+                skew * 100.0,
+                self.skew_threshold * 100.0,
+                extra={
+                    "fn": fn,
+                    "device": slowest,
+                    "skew_frac": round(skew, 4),
+                    "patience": self.patience,
+                },
+            )
+        return skew
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            fns = {
+                fn: {
+                    **{k: v for k, v in e.items() if k != "devices"},
+                    "devices": {d: dict(v) for d, v in e["devices"].items()},
+                }
+                for fn, e in self._fns.items()
+            }
+        return {
+            "skew_threshold": self.skew_threshold,
+            "patience": self.patience,
+            "functions": fns,
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._fns.clear()
+
+
+# ---------------------------------------------------------------------------
 # process defaults + the /efficiency.json body
 
 #: process-global trackers: device telemetry is per-process like the jit
@@ -898,6 +1082,11 @@ def compare_bench(
 #: the one accelerator
 DEVICE_EFFICIENCY = EfficiencyTracker()
 RECOMPILES = RecompileTracker()
+STRAGGLERS = StragglerBoard()
+
+
+def default_stragglers() -> StragglerBoard:
+    return STRAGGLERS
 
 
 def default_efficiency() -> EfficiencyTracker:
@@ -929,6 +1118,19 @@ def shard_snapshot(registry: MetricsRegistry | None = None) -> dict[str, Any]:
             entry["seconds"] = round(float(getattr(child, "sum", 0.0)), 6)
     devices = sorted({d for per_fn in out.values() for d in per_fn})
     return {"devices": devices, "functions": out}
+
+
+def shards_snapshot(
+    registry: MetricsRegistry | None = None,
+    stragglers: StragglerBoard | None = None,
+) -> dict[str, Any]:
+    """The ``GET /shards.json`` body: per-device placement attribution
+    (bytes/waves/seconds per fn) plus the rolling straggler scoreboard —
+    the one scrape that answers "which device is dragging the mesh"."""
+    return {
+        "shards": shard_snapshot(registry),
+        "stragglers": (stragglers or STRAGGLERS).snapshot(),
+    }
 
 
 def device_snapshot(

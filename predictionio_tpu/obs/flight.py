@@ -94,9 +94,14 @@ class FlightRecorder:
                 heapq.heapreplace(self._slowest, item)
 
     def snapshot(
-        self, request_id: str | None = None, limit: int | None = None
+        self,
+        request_id: str | None = None,
+        limit: int | None = None,
+        trace_id: str | None = None,
     ) -> dict[str, Any]:
-        """Slowest (descending duration) and errored (newest first)."""
+        """Slowest (descending duration) and errored (newest first);
+        ``trace_id`` filters to one cross-process trace's entries (the
+        click-through from an SLO exemplar or an assembled timeline)."""
         with self._lock:
             slowest = [e for _, _, e in sorted(self._slowest, reverse=True)]
             errors = list(self._errors)[::-1]
@@ -104,6 +109,9 @@ class FlightRecorder:
         if request_id is not None:
             slowest = [e for e in slowest if e.get("request_id") == request_id]
             errors = [e for e in errors if e.get("request_id") == request_id]
+        if trace_id is not None:
+            slowest = [e for e in slowest if e.get("trace_id") == trace_id]
+            errors = [e for e in errors if e.get("trace_id") == trace_id]
         if limit is not None:
             slowest, errors = slowest[:limit], errors[:limit]
         return {"recorded_total": total, "slowest": slowest, "errors": errors}

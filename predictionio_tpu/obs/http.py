@@ -40,7 +40,8 @@ from __future__ import annotations
 import json
 from typing import Any, Callable, Mapping
 
-from predictionio_tpu.obs.device import device_snapshot
+from predictionio_tpu.obs.device import device_snapshot, shards_snapshot
+from predictionio_tpu.obs.disttrace import FRAGMENTS, set_process_name
 from predictionio_tpu.obs.flight import FlightRecorder, current_annotations
 from predictionio_tpu.obs.logging import get_log_ring
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
@@ -62,9 +63,11 @@ _OBS_PATHS = frozenset(
         "/metrics",
         "/metrics.json",
         "/traces.json",
+        "/spans.json",
         "/logs.json",
         "/quality.json",
         "/efficiency.json",
+        "/shards.json",
         "/healthz",
         "/readyz",
         "/slo.json",
@@ -82,9 +85,12 @@ def record_request_outcome(app, req, resp, duration_s: float, span) -> None:
     observability routes and for the observability routes themselves."""
     if is_observability_path(req.path):
         return
+    trace_id = getattr(span, "trace_id", None)
     slo: SLOTracker | None = getattr(app, "slo", None)
     if slo is not None:
-        slo.record(resp.status < 500, duration_s)
+        # the trace id rides along as the SLO-breach exemplar: one slow or
+        # errored request links straight to its assembled trace
+        slo.record(resp.status < 500, duration_s, trace_id=trace_id)
     flight: FlightRecorder | None = getattr(app, "flight", None)
     if flight is None:
         return
@@ -101,6 +107,8 @@ def record_request_outcome(app, req, resp, duration_s: float, span) -> None:
         "response_bytes": len(resp.encoded()[0]),
         "span": span.to_dict(),
     }
+    if trace_id:
+        entry["trace_id"] = trace_id
     ann = current_annotations()
     if ann:
         entry.update(ann)
@@ -154,6 +162,9 @@ def add_observability_routes(
         key_matches,
     )
 
+    # name this process's trace fragments after its first server (a `pio
+    # deploy` with an embedded event server stays "predictionserver")
+    set_process_name(app.name)
     reg = registry or REGISTRY
     app.slo = slo or SLOTracker()
     # no flight recorder without its route: the event server's ingest path
@@ -223,6 +234,23 @@ def add_observability_routes(
             200, {"traces": recent_traces(min(max(limit, 0), 256))}
         )
 
+    # -- cross-process span fragments ----------------------------------------
+    # what the distributed-trace assembler (obs/timeline.py, `pio trace`)
+    # fetches from every participating daemon; gated like /traces.json
+    @route("GET", "/spans\\.json")
+    def spans_json(req: Request) -> Response:
+        try:
+            limit = int(req.query.get("limit", 50))
+        except ValueError:
+            return json_response(400, {"message": "limit must be an integer"})
+        return json_response(
+            200,
+            FRAGMENTS.snapshot(
+                trace_id=req.query.get("trace_id"),
+                limit=min(max(limit, 0), 256),
+            ),
+        )
+
     if not debug_routes:
         _add_health_routes(app, route)
         return app
@@ -260,6 +288,13 @@ def add_observability_routes(
     def efficiency_json(req: Request) -> Response:
         return json_response(200, device_snapshot())
 
+    # -- sharded-mesh straggler scoreboard -----------------------------------
+    # per-device placement attribution + the rolling straggler board: the
+    # one scrape answering "which device is dragging the mesh"
+    @route("GET", "/shards\\.json")
+    def shards_json(req: Request) -> Response:
+        return json_response(200, shards_snapshot(reg))
+
     # -- flight recorder -----------------------------------------------------
     @route("GET", "/debug/flight\\.json")
     def flight_json(req: Request) -> Response:
@@ -272,7 +307,9 @@ def add_observability_routes(
                     400, {"message": "limit must be an integer"}
                 )
         snap = app.flight.snapshot(
-            request_id=req.query.get("request_id"), limit=limit
+            request_id=req.query.get("request_id"),
+            trace_id=req.query.get("trace_id"),
+            limit=limit,
         )
         return Response(
             200,

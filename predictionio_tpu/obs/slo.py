@@ -19,10 +19,14 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Mapping
 
 #: a window is "degraded" when either burn rate crosses this
 DEGRADED_BURN_RATE = 1.0
+
+#: SLO-breach exemplars retained (newest evict oldest)
+EXEMPLAR_CAPACITY = 16
 
 
 def _now() -> float:
@@ -58,19 +62,36 @@ class SLOTracker:
         n = int(window_s / bucket_s)
         #: ring of [bucket_index, total, errors, slow]
         self._buckets: list[list[float]] = [[-1, 0, 0, 0] for _ in range(n)]
+        #: trace-id exemplars of recent SLO-breaching requests — the jump
+        #: from "p99 moved" straight to ONE assembled cross-process trace
+        self._exemplars: deque[dict[str, Any]] = deque(
+            maxlen=EXEMPLAR_CAPACITY
+        )
         self._started = _now()
 
-    def record(self, ok: bool, duration_s: float) -> None:
+    def record(
+        self, ok: bool, duration_s: float, trace_id: str | None = None
+    ) -> None:
         idx = int(_now() / self.bucket_s)
         slot = self._buckets[idx % len(self._buckets)]
+        slow = duration_s > self.latency_threshold_s
         with self._lock:
             if slot[0] != idx:  # ring slot holds an expired window: reset
                 slot[0], slot[1], slot[2], slot[3] = idx, 0, 0, 0
             slot[1] += 1
             if not ok:
                 slot[2] += 1
-            if duration_s > self.latency_threshold_s:
+            if slow:
                 slot[3] += 1
+            if trace_id and (slow or not ok):
+                self._exemplars.append(
+                    {
+                        "trace_id": trace_id,
+                        "reason": "error" if not ok else "slow",
+                        "duration_s": round(duration_s, 6),
+                        "ts": round(time.time(), 3),
+                    }
+                )
 
     def _window_counts(self) -> tuple[int, int, int]:
         horizon = int(_now() / self.bucket_s) - len(self._buckets)
@@ -99,7 +120,10 @@ class SLOTracker:
         error_burn = self._burn_rate(errors, total, self.availability_target)
         latency_burn = self._burn_rate(slow, total, self.latency_target)
         degraded = max(error_burn, latency_burn) > DEGRADED_BURN_RATE
+        with self._lock:
+            exemplars = list(self._exemplars)[::-1]
         return {
+            "exemplars": exemplars,
             "window_s": self.window_s,
             "requests": total,
             "errors": errors,

@@ -26,7 +26,12 @@ import time
 from collections import deque
 from typing import Any
 
-from predictionio_tpu.obs.logging import get_request_id
+from predictionio_tpu.obs.disttrace import (
+    collect as _collect_fragments,
+    get_parent_span,
+    new_span_id,
+)
+from predictionio_tpu.obs.logging import get_request_id, get_trace_id
 from predictionio_tpu.obs.metrics import (
     REGISTRY,
     STAGE_BUCKETS,
@@ -51,7 +56,8 @@ class Span:
 
     __slots__ = (
         "name", "start_s", "duration_s", "children", "error",
-        "request_id", "tags",
+        "request_id", "tags", "span_id", "parent_id", "trace_id",
+        "start_ts",
     )
 
     def __init__(self, name: str):
@@ -65,6 +71,14 @@ class Span:
         #: small free-form annotations (route, status, ...) — keep it small;
         #: every root span's dict lands in the trace ring
         self.tags: dict[str, Any] | None = None
+        #: distributed-tracing identity (obs/disttrace.py): a per-span id,
+        #: the cross-process parent (root spans adopt X-Pio-Parent-Span),
+        #: the trace this span belongs to, and a wall-clock start so
+        #: fragments from different processes align on one timeline
+        self.span_id: str = ""
+        self.parent_id: str | None = None
+        self.trace_id: str | None = None
+        self.start_ts: float = 0.0
 
     def to_dict(self) -> dict[str, Any]:
         d: dict[str, Any] = {
@@ -73,6 +87,8 @@ class Span:
         }
         if self.request_id:
             d["request_id"] = self.request_id
+        if self.trace_id:
+            d["trace_id"] = self.trace_id
         if self.tags:
             d.update(self.tags)
         if self.error:
@@ -90,29 +106,45 @@ class Span:
 
 
 class trace:
-    """Context manager: ``with trace("train.prepare") as span: ...``"""
+    """Context manager: ``with trace("train.prepare") as span: ...``
 
-    __slots__ = ("span", "_registry", "_record")
+    ``record=False`` skips the span-duration histogram; ``ring=False``
+    keeps a ROOT span out of the recent-traces ring (for high-volume
+    infrastructure spans like storage round trips that would otherwise
+    evict real request traces from ``/traces.json``) — cross-process
+    fragment collection is unaffected by either."""
+
+    __slots__ = ("span", "_registry", "_record", "_ring")
 
     def __init__(
         self,
         name: str,
         registry: MetricsRegistry | None = None,
         record: bool = True,
+        ring: bool = True,
     ):
         self.span = Span(name)
         self._registry = registry or REGISTRY
         self._record = record
+        self._ring = ring
 
     def __enter__(self) -> Span:
         stack = _stack_var.get()
         if stack is None:
             stack = []
             _stack_var.set(stack)
-        stack.append(self.span)
-        self.span.request_id = get_request_id()
-        self.span.start_s = time.perf_counter()
-        return self.span
+        span = self.span
+        span.request_id = get_request_id()
+        span.trace_id = get_trace_id()
+        span.span_id = new_span_id()
+        if not stack:
+            # a ROOT span parents to the cross-process caller (the span id
+            # adopted from X-Pio-Parent-Span); children parent in-tree
+            span.parent_id = get_parent_span()
+        stack.append(span)
+        span.start_ts = time.time()
+        span.start_s = time.perf_counter()
+        return span
 
     def __exit__(self, exc_type, exc, tb) -> None:
         self.span.duration_s = time.perf_counter() - self.span.start_s
@@ -123,8 +155,16 @@ class trace:
         if stack:
             stack[-1].children.append(self.span)
         else:
-            with _ring_lock:
-                _ring.append(self.span.to_dict())
+            if self._ring:
+                with _ring_lock:
+                    _ring.append(self.span.to_dict())
+            if self.span.trace_id:
+                try:
+                    # flatten the finished tree into cross-process fragments
+                    # (bounded per-process store served at /spans.json)
+                    _collect_fragments(self.span)
+                except Exception:
+                    pass  # telemetry must never break the traced block
         if self._record:
             self._registry.histogram(
                 "pio_span_seconds",

@@ -895,10 +895,37 @@ def train_als(
         "als.train_step", step, u, i, r, valid, U0, V0,
         signature=sig, defer=True,
     )
+    # per-iteration timeline track: with PIO_TRAIN_STEP_TIMELINE=1 and a
+    # bound trace id (`pio bench --devices N` step-timeline mode, an
+    # operator chasing step jitter), each solve iteration becomes one
+    # device-track fragment in the distributed timeline.  Costs one
+    # host-device block per iteration, so it needs the EXPLICIT opt-in —
+    # a trace id alone is not enough, because run_train binds the engine
+    # instance id as every training run's correlation (and thus trace) id,
+    # and production retrains must keep the fully async dispatch loop.
+    import os
+
+    from predictionio_tpu.obs.disttrace import record_fragment
+    from predictionio_tpu.obs.logging import get_trace_id
+
+    emit_steps = (
+        bool(os.environ.get("PIO_TRAIN_STEP_TIMELINE"))
+        and get_trace_id() is not None
+    )
     t0 = _time.perf_counter()
     U, V = U0, V0
-    for _ in range(p.num_iterations):
+    for it in range(p.num_iterations):
+        t_step = _time.time()
         U, V = step(u, i, r, valid, U, V)
+        if emit_steps:
+            jax.block_until_ready(V)
+            record_fragment(
+                f"als.train_step[{it}]",
+                t_step,
+                _time.time() - t_step,
+                track=f"train:{n_dev}dev",
+                tags={"iteration": it, "devices": n_dev},
+            )
     U = jax.block_until_ready(U)
     wall_s = _time.perf_counter() - t0
     if eff.cached_cost("als.train_step", sig) is None:

@@ -260,18 +260,20 @@ def shard_attribution(tree: Any) -> dict[str, dict[str, float]]:
 def meter_shards(
     fn: str,
     tree: Any,
-    seconds: float | None = None,
+    seconds: float | Mapping[str, float] | None = None,
     registry=None,
 ) -> dict[str, dict[str, float]]:
     """The per-device attribution hook: record where ``fn``'s arrays live.
 
     Sets ``pio_shard_bytes{fn,device}`` per device and — when ``seconds``
-    is given — observes ``pio_shard_seconds{fn,device}`` with the wall
-    clock the caller measured for the sharded step (every participating
-    device spans the same SPMD wall time; skewed per-device time needs the
-    profiler).  This is the attribution seam sharded serving/training
-    extends: the wave metrics' ``device`` label and these families share
-    the ``platform:id`` labeling.  Returns the attribution map.
+    is given — observes ``pio_shard_seconds{fn,device}``: a scalar means
+    one SPMD wall clock spanning every participant (the training-loop
+    case), a ``{device: seconds}`` mapping records each device's OWN
+    measured time (the per-shard settle clock ``placement.settle_shards``
+    produces — what the straggler board skews on).  This is the
+    attribution seam sharded serving/training extends: the wave metrics'
+    ``device`` label and these families share the ``platform:id``
+    labeling.  Returns the attribution map.
     """
     from predictionio_tpu.obs.metrics import REGISTRY, STAGE_BUCKETS
 
@@ -290,9 +292,13 @@ def meter_shards(
         labelnames=("fn", "device"),
         buckets=STAGE_BUCKETS,
     )
+    per_device = seconds if isinstance(seconds, Mapping) else None
     for label, entry in attribution.items():
         g_bytes.labels(fn, label).set(entry["bytes"])
-        if seconds is not None:
+        if per_device is not None:
+            if label in per_device:
+                h_seconds.labels(fn, label).observe(float(per_device[label]))
+        elif seconds is not None:
             h_seconds.labels(fn, label).observe(seconds)
     return attribution
 

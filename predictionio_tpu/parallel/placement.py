@@ -31,6 +31,7 @@ boundary).
 from __future__ import annotations
 
 import math
+import time
 from dataclasses import dataclass, field
 from functools import lru_cache
 from typing import Any, Callable, Mapping, Sequence
@@ -311,6 +312,75 @@ def build_sharded_topk(
 # one sharded wave, fully instrumented
 
 
+#: settle-poll cadence: readiness is sampled every POLL (so per-device
+#: resolution is ~200 µs — far below the skew thresholds measured in
+#: multi-ms waves), and a wave stuck past MAX_WAIT falls back to blocking
+#: so settle measurement can never hang a healthy dispatch path
+_SETTLE_POLL_S = 0.0002
+_SETTLE_MAX_WAIT_S = 30.0
+
+
+def settle_shards(result: Any, t0: float) -> dict[str, float]:
+    """Per-device **settle clock** of one sharded dispatch: sample every
+    device's ``is_ready()`` on a fixed cadence and record the observed
+    seconds-since-dispatch at which that device's slices became ready.
+    Because readiness is *polled* across all devices rather than blocked on
+    one at a time, a straggling device shows a larger settle time no matter
+    where it sits in device order — devices that finished earlier were
+    already marked ready on an earlier poll round.
+
+    The ``shard.settle`` fault seam rides here as a QUERY
+    (:meth:`~predictionio_tpu.resilience.faults.FaultInjector.latency`):
+    a ``kind="latency"`` rule matching a device label *defers that device's
+    observed readiness* instead of sleeping the poll — how the chaos suite
+    manufactures a deterministic straggler on a CPU mesh whose virtual
+    devices all finish together.  Returns ``{}`` for unsharded results
+    (host arrays, single-device) and for runtimes without per-array
+    readiness probes."""
+    from predictionio_tpu.resilience import faults
+
+    shards = getattr(result, "addressable_shards", None)
+    if not shards:
+        return {}
+    pending: dict[str, list[Any]] = {}
+    for shard in shards:
+        d = shard.device
+        if not hasattr(shard.data, "is_ready"):
+            return {}
+        pending.setdefault(f"{d.platform}:{d.id}", []).append(shard.data)
+    if len(pending) < 2:
+        return {}
+    out: dict[str, float] = {}
+    give_up = t0 + _SETTLE_MAX_WAIT_S
+    # this poll IS the measurement: XLA exposes no per-array completion
+    # callback to wait on, so sampling is_ready() on a fixed cadence is
+    # the only order-independent way to clock each device's readiness
+    # pio: ignore[PIO-CONC002]
+    while pending:
+        now = time.perf_counter()
+        for label in list(pending):
+            try:
+                ready = all(x.is_ready() for x in pending[label])
+            except Exception:
+                ready = True  # a failed probe must not wedge the wave
+            if ready:
+                out[label] = now - t0
+                del pending[label]
+        if not pending:
+            break
+        if now > give_up:
+            # pathological stall: stop attributing, block like the caller
+            # is about to anyway, and charge the stragglers the full wait
+            for label in pending:
+                out[label] = time.perf_counter() - t0
+            break
+        time.sleep(_SETTLE_POLL_S)
+    if faults.ACTIVE is not None:
+        for label in out:
+            out[label] += faults.ACTIVE.latency("shard.settle", label)
+    return out
+
+
 def run_observed_wave(
     fn: str,
     *,
@@ -336,8 +406,6 @@ def run_observed_wave(
     outputs (e.g. the gathered query rows) that only exist inside
     ``compute``.  It is still ``defer=True`` — never inside a wave
     deadline."""
-    import time
-
     from predictionio_tpu.obs import device as device_obs
     from predictionio_tpu.parallel.mesh import meter_shards
 
@@ -349,6 +417,9 @@ def run_observed_wave(
     t_dev = time.perf_counter()
     with device_obs.wave_stage("compute"):
         packed_dev, cost_args = compute(dev_input)
+        # per-shard settle clock: each participating device's OWN observed
+        # readiness (the straggler board's input), then the whole result
+        shard_seconds = settle_shards(packed_dev, t_dev)
         packed_dev.block_until_ready()
     compute_s = time.perf_counter() - t_dev
     eff.capture_cost(fn, kernel, *cost_args, signature=sig, defer=True)
@@ -359,10 +430,19 @@ def run_observed_wave(
         device_obs.note_transfer("d2h", packed.nbytes)
     eff.observe(fn, compute_s, signature=sig)
     # per-wave per-device attribution: which shard held how many bytes for
-    # this wave, and the wave's wall clock per participant
-    device_obs.note_wave_shards(
-        meter_shards(fn, shard_arrays, seconds=compute_s)
+    # this wave, and each participant's measured time (per-device settle
+    # seconds when the result is sharded, the SPMD wall clock otherwise)
+    attribution = meter_shards(
+        fn, shard_arrays, seconds=shard_seconds or compute_s
     )
+    device_obs.note_wave_shards(attribution)
+    if shard_seconds:
+        device_obs.note_shard_seconds(shard_seconds)
+        device_obs.default_stragglers().record_wave(
+            fn,
+            shard_seconds,
+            {dev: e.get("bytes", 0.0) for dev, e in attribution.items()},
+        )
     return packed
 
 

@@ -127,6 +127,33 @@ class FaultInjector:
                 f"{r.message} [{r.kind} @ {seam} {label}]".strip()
             )
 
+    def latency(self, seam: str, label: str = "") -> float:
+        """Latency-kind rules as a QUERY: return the matching rules' total
+        injected delay instead of sleeping it, for seams that fold the
+        delay into their own clock — the per-shard settle measurement
+        (``placement.settle_shards``) defers one device's observed
+        readiness rather than stalling the poll over every device.  Same
+        after/count/probability bookkeeping as :meth:`check`; raising
+        kinds never fire here."""
+        total = 0.0
+        for r in self.rules:
+            if r.seam != seam or r.kind not in ("latency", "slow_response"):
+                continue
+            if r.match and r.match not in label:
+                continue
+            with self._lock:
+                n = r.seen
+                r.seen += 1
+                if n < r.after:
+                    continue
+                if r.count is not None and r.fired >= r.count:
+                    continue
+                if r.probability < 1.0 and self._rng.random() >= r.probability:
+                    continue
+                r.fired += 1
+            total += r.latency_s
+        return total
+
     def corrupt(self, seam: str, label: str, data: bytes) -> bytes:
         """Data-seam injection: deterministically flip bytes when a
         ``kind="corrupt"`` rule matches (same after/count/probability

@@ -26,6 +26,12 @@ import time
 from typing import Any
 from urllib.parse import parse_qs, urlsplit
 
+from predictionio_tpu.obs.disttrace import (
+    TRACE_ID_HEADER,
+    adopt_trace_context,
+    bind_parent_span,
+    reset_parent_span,
+)
 from predictionio_tpu.obs.flight import begin_annotations, end_annotations
 from predictionio_tpu.obs.http import (
     is_observability_path,
@@ -103,7 +109,11 @@ async def _observe_app_request(
     if shed is not None:
         return shed
     budget = request_budget(app, req)
-    tokens = set_request_context(rid)
+    # cross-process tracing: adopt the caller's trace id (or start a new
+    # trace under this request id) and the span our roots parent under
+    tid, parent_span = adopt_trace_context(req.headers, rid)
+    tokens = set_request_context(rid, tid)
+    ptoken = bind_parent_span(parent_span)
     ann_token = begin_annotations()
     try:
         if budget is not None and budget <= 0:
@@ -122,11 +132,13 @@ async def _observe_app_request(
                 )
             except Exception:  # telemetry must never fail the request
                 pass
+        resp.headers.setdefault(TRACE_ID_HEADER, tid)
         return resp
     finally:
         if adm is not None:
             adm.release()
         end_annotations(ann_token)
+        reset_parent_span(ptoken)
         reset_request_context(tokens)
 
 

@@ -10,6 +10,8 @@ EvaluationInstance row, CoreWorkflow.scala:144-155).
 from __future__ import annotations
 
 import html
+import json
+import os
 from urllib.parse import quote
 
 from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
@@ -18,6 +20,12 @@ from predictionio_tpu.obs.http import add_observability_routes
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
 from predictionio_tpu.obs.quality import QualityMonitor, default_quality
 from predictionio_tpu.obs.slo import run_readiness
+from predictionio_tpu.obs.timeline import (
+    Timeline,
+    TraceAssemblyError,
+    TraceNode,
+    collect_trace,
+)
 from predictionio_tpu.obs.tracing import recent_traces
 from predictionio_tpu.server.httpd import (
     AppServer,
@@ -201,18 +209,27 @@ def _efficiency_html(registry: MetricsRegistry) -> str:
 
 def _traces_table_html(n: int = 15, access_key: str | None = None) -> str:
     """Recent root spans; rows with a request id link to the matching
-    flight-recorder entry for the full per-request record.  On a key-gated
-    dashboard the link carries the accessKey (the Dashboard.scala:47
-    link-parity rationale the query-param transport exists for) so clicking
-    through from an authenticated page doesn't 401."""
-    key_param = f"&accessKey={quote(access_key)}" if access_key else ""
+    flight-recorder entry for the full per-request record, and rows with a
+    trace id link to the ASSEMBLED cross-process waterfall (``/trace/<id>``)
+    — not just this process's fragment of it.  On a key-gated dashboard
+    every link carries the accessKey (the Dashboard.scala:47 link-parity
+    rationale the query-param transport exists for) so clicking through
+    from an authenticated page doesn't 401."""
+    key_amp = f"&accessKey={quote(access_key)}" if access_key else ""
+    key_q = f"?accessKey={quote(access_key)}" if access_key else ""
     rows = []
     for t in recent_traces(n):
         rid = t.get("request_id") or ""
         rid_cell = (
             f"<a href='/debug/flight.json?request_id={quote(rid)}"
-            f"{key_param}'>{html.escape(rid)}</a>"
+            f"{key_amp}'>{html.escape(rid)}</a>"
             if rid
+            else ""
+        )
+        tid = t.get("trace_id") or ""
+        tid_cell = (
+            f"<a href='/trace/{quote(tid)}{key_q}'>{html.escape(tid)}</a>"
+            if tid
             else ""
         )
         children = ", ".join(
@@ -222,16 +239,87 @@ def _traces_table_html(n: int = 15, access_key: str | None = None) -> str:
             f"<tr><td>{html.escape(t.get('name', ''))}</td>"
             f"<td>{t.get('duration_s', 0):.6f}</td>"
             f"<td>{rid_cell}</td>"
+            f"<td>{tid_cell}</td>"
             f"<td>{html.escape(t.get('error') or '')}</td>"
             f"<td>{html.escape(children)}</td></tr>"
         )
     return (
         "<h2>Recent traces</h2><table border='1'>"
-        "<tr><th>span</th><th>seconds</th><th>request</th>"
+        "<tr><th>span</th><th>seconds</th><th>request</th><th>trace</th>"
         "<th>error</th><th>children</th></tr>"
         + "".join(rows)
         + "</table>"
     )
+
+
+def _waterfall_html(tl: Timeline, access_key: str | None = None) -> str:
+    """One assembled trace as an HTML waterfall: a lane per process (device
+    tracks indented under theirs), each span a positioned bar over the
+    trace's full wall-clock extent plus the indented name/timing text the
+    text renderer prints.  Pure inline-styled HTML — the dashboard has no
+    static assets."""
+    t0 = tl.t0
+    end = max(
+        (n.start_s + n.duration_s for n in tl.nodes.values()), default=t0
+    )
+    span_ms = max((end - t0) * 1e3, 1e-6)
+    key_amp = f"&accessKey={quote(access_key)}" if access_key else ""
+    parts = [
+        f"<h2>Trace {html.escape(tl.trace_id)}</h2>"
+        f"<p>{len(tl.processes)} process(es), {tl.span_count} span(s), "
+        f"{span_ms:.1f} ms"
+        f" — <a href='/spans.json?trace_id={quote(tl.trace_id)}{key_amp}'>"
+        "this process's raw fragments</a>, "
+        f"<a href='/trace/{quote(tl.trace_id)}?format=perfetto{key_amp}'>"
+        "Perfetto JSON</a> (open in https://ui.perfetto.dev); assemble "
+        f"across daemons with <code>pio trace {html.escape(tl.trace_id)} "
+        "--from URL,URL --perfetto out.json</code></p>"
+    ]
+    for err in tl.source_errors:
+        parts.append(f"<p><b>source error:</b> {html.escape(err)}</p>")
+    by_process: dict[str, list[tuple[int, TraceNode]]] = {}
+
+    def index(node: TraceNode, depth: int) -> None:
+        by_process.setdefault(node.process, []).append((depth, node))
+        for c in node.children:
+            index(c, depth + 1)
+
+    for root in tl.roots:
+        index(root, 0)
+    for proc in tl.processes:
+        rows = []
+        for depth, node in by_process.get(proc, []):
+            left = (node.start_s - t0) * 1e3 / span_ms * 100.0
+            width = max(node.duration_s * 1e3 / span_ms * 100.0, 0.2)
+            device = node.track != "spans"
+            color = "#8bc" if device else "#c86"
+            label = (
+                f"{'&nbsp;' * (2 * depth)}{html.escape(node.name)}"
+                f"{' [' + html.escape(node.track) + ']' if device else ''}"
+                f" +{(node.start_s - t0) * 1e3:.2f}ms "
+                f"{node.duration_s * 1e3:.3f}ms"
+                f"{' ORPHAN' if node.orphan else ''}"
+                + (
+                    " ERROR: " + html.escape(str(node.fragment["error"]))
+                    if node.fragment.get("error")
+                    else ""
+                )
+            )
+            rows.append(
+                "<tr>"
+                f"<td style='white-space:nowrap'>{label}</td>"
+                "<td style='width:50%'><div style='position:relative;"
+                "height:10px;background:#eee'>"
+                f"<div style='position:absolute;left:{left:.2f}%;"
+                f"width:{width:.2f}%;height:10px;background:{color}'>"
+                "</div></div></td></tr>"
+            )
+        parts.append(
+            f"<h3>{html.escape(proc)}</h3>"
+            "<table border='0' style='width:100%'>" + "".join(rows)
+            + "</table>"
+        )
+    return "".join(parts)
 
 
 def _health_html(app: HTTPApp) -> str:
@@ -265,12 +353,25 @@ def create_dashboard_app(
     storage: StorageRuntime | None = None,
     access_key: str | None = None,
     quality: QualityMonitor | None = None,
+    trace_sources: list[str] | None = None,
 ) -> HTTPApp:
     """``access_key`` gates every route (Dashboard.scala:47 mixes in
-    KeyAuthentication); TLS comes from the AppServer layer below."""
+    KeyAuthentication); TLS comes from the AppServer layer below.
+
+    ``trace_sources`` (default: ``PIO_TRACE_SOURCES``, comma-separated base
+    URLs) names the other daemons' ``/spans.json`` endpoints the
+    ``/trace/<id>`` waterfall assembles across — unset, the waterfall shows
+    this process's fragments only (still useful for a `pio deploy` whose
+    embedded servers share one store)."""
     storage = storage or get_storage()
     app = HTTPApp("dashboard", access_key=access_key)
     quality = quality or default_quality()
+    if trace_sources is None:
+        trace_sources = [
+            u.strip()
+            for u in os.environ.get("PIO_TRACE_SOURCES", "").split(",")
+            if u.strip()
+        ]
 
     def _metadata_ready() -> bool:
         storage.evaluation_instances().get_completed()
@@ -309,6 +410,39 @@ def create_dashboard_app(
             f"{_efficiency_html(REGISTRY)}"
             f"{_traces_table_html(access_key=access_key)}"
             f"{_metrics_table_html(REGISTRY)}</body></html>",
+        )
+
+    @app.route("GET", "/trace/(?P<tid>[^/]+)")
+    def trace_waterfall(req: Request) -> Response:
+        # the assembled cross-process view the Recent-traces rows link to:
+        # local fragments + every configured daemon's /spans.json, merged
+        # into per-process lanes (dead daemons cost their fragments only)
+        tid = req.params["tid"]
+        try:
+            # short per-source timeout: this blocks a dashboard serving
+            # thread, and fetches run concurrently, so a dead daemon in
+            # trace_sources costs one bounded wait — not 10 s per corpse
+            tl = collect_trace(
+                tid,
+                urls=trace_sources,
+                include_local=True,
+                access_key=access_key,
+                timeout=3.0,
+            )
+        except TraceAssemblyError as e:
+            return error_response(404, str(e))
+        if req.query.get("format") == "perfetto":
+            return Response(
+                200,
+                json.dumps(tl.to_chrome_trace()),
+                content_type="application/json",
+            )
+        return Response(
+            200,
+            "<html><head><title>Trace "
+            f"{html.escape(tid)}</title></head><body>"
+            + _waterfall_html(tl, access_key=access_key)
+            + "</body></html>",
         )
 
     @app.route("GET", "/engine_instances/(?P<iid>[^/]+)")

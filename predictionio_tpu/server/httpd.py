@@ -291,6 +291,12 @@ def observe_request(
     span, echo ``X-Pio-Request-Id``, and feed the SLO tracker + flight
     recorder.  Observability/probe paths skip the span + accounting so
     scrapes never pollute the trace ring or the SLO window."""
+    from predictionio_tpu.obs.disttrace import (
+        TRACE_ID_HEADER,
+        adopt_trace_context,
+        bind_parent_span,
+        reset_parent_span,
+    )
     from predictionio_tpu.obs.flight import begin_annotations, end_annotations
     from predictionio_tpu.obs.http import (
         is_observability_path,
@@ -308,7 +314,12 @@ def observe_request(
         shed.headers.setdefault(REQUEST_ID_HEADER, rid)
         return shed
     budget = request_budget(app, req)
-    tokens = set_request_context(rid)
+    # cross-process tracing: adopt the caller's trace id (or start a new
+    # trace under this request id) and the parent span this process's root
+    # spans should hang under
+    tid, parent_span = adopt_trace_context(req.headers, rid)
+    tokens = set_request_context(rid, tid)
+    ptoken = bind_parent_span(parent_span)
     ann_token = begin_annotations()
     t0 = time.perf_counter()
     try:
@@ -331,11 +342,13 @@ def observe_request(
                 except Exception:  # telemetry must never fail the request
                     pass
         resp.headers.setdefault(REQUEST_ID_HEADER, rid)
+        resp.headers.setdefault(TRACE_ID_HEADER, tid)
         return resp
     finally:
         if adm is not None:
             adm.release()
         end_annotations(ann_token)
+        reset_parent_span(ptoken)
         reset_request_context(tokens)
 
 

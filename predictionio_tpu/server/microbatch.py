@@ -34,6 +34,7 @@ Resilience semantics (docs/robustness.md):
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
 import threading
 import time
@@ -41,7 +42,17 @@ from collections import deque
 from typing import Any, Callable, Sequence
 
 from predictionio_tpu.obs import device as device_obs
-from predictionio_tpu.obs.logging import get_request_id, ring_debug
+from predictionio_tpu.obs.disttrace import (
+    bind_parent_span,
+    current_trace_context,
+    reset_parent_span,
+)
+from predictionio_tpu.obs.logging import (
+    get_request_id,
+    reset_request_context,
+    ring_debug,
+    set_request_context,
+)
 from predictionio_tpu.obs.metrics import (
     REGISTRY,
     MetricsRegistry,
@@ -94,9 +105,13 @@ class MicroBatcher:
         #: retry a failed multi-item wave one item at a time so a poison
         #: query fails alone (one bounded pass, never recursive)
         self.solo_retry = solo_retry
-        #: (item, future, enqueue_time, request_id, meta, deadline)
+        #: (item, future, enqueue_time, request_id, meta, deadline,
+        #:  (trace_id, parent_span_id))
         self._pending: deque[
-            tuple[Any, asyncio.Future, float, str | None, dict | None, float | None]
+            tuple[
+                Any, asyncio.Future, float, str | None, dict | None,
+                float | None, tuple,
+            ]
         ] = deque()
         self._cond = threading.Condition()
         self._worker: threading.Thread | None = None
@@ -201,6 +216,10 @@ class MicroBatcher:
                     get_request_id(),
                     meta,
                     get_deadline(),
+                    # submitter's trace context (trace id + innermost open
+                    # span), re-bound around batch_fn so a wave's outbound
+                    # storage calls join the request's cross-process trace
+                    current_trace_context(),
                 )
             )
             self._m_queue_depth.set(len(self._pending))
@@ -227,7 +246,7 @@ class MicroBatcher:
             self._cond.notify_all()
         err = RuntimeError("MicroBatcher closed during shutdown")
         now = _deadline_now()
-        for _, fut, _t, _rid, _meta, dl in dropped:
+        for _, fut, _t, _rid, _meta, dl, _tc in dropped:
             item_err: BaseException = err
             if dl is not None and dl <= now:
                 self._m_expired.inc()
@@ -296,7 +315,7 @@ class MicroBatcher:
         now = _deadline_now()
         live: list[tuple] = []
         for entry in wave:
-            _, fut, t_enq, _, meta, dl = entry
+            _, fut, t_enq, _, meta, dl, _tc = entry
             if dl is not None and dl <= now:
                 self._m_expired.inc()
                 if meta is not None:
@@ -313,13 +332,13 @@ class MicroBatcher:
                 live.append(entry)
         if not live:
             return
-        items = [it for it, _, _, _, _, _ in live]
-        futures = [f for _, f, _, _, _, _ in live]
-        rids = [r for _, _, _, r, _, _ in live if r]
-        deadlines = [dl for _, _, _, _, _, dl in live if dl is not None]
+        items = [it for it, _, _, _, _, _, _ in live]
+        futures = [f for _, f, _, _, _, _, _ in live]
+        rids = [r for _, _, _, r, _, _, _ in live if r]
+        deadlines = [dl for _, _, _, _, _, dl, _ in live if dl is not None]
         wave_deadline = min(deadlines) if deadlines else None
         self._m_batch_size.observe(len(items))
-        for _, _, t_enq, _, _, _ in live:
+        for _, _, t_enq, _, _, _, _ in live:
             self._m_queue_wait.observe(t_dispatch - t_enq)
         # the correlation line: a wave's log entry names the requests it
         # coalesced, so one slow query's request_id finds its wave
@@ -335,26 +354,35 @@ class MicroBatcher:
         # all futures in a wave come from submit() calls on the same
         # server loop; resolve with ONE loop wakeup
         loop = futures[0].get_loop()
+        wave_t0 = time.time()
         try:
             # re-bind the wave's tightest deadline around batch_fn so
             # outbound storage calls inside it stay under budget; the wave
             # timeline scope collects the engine's host_gather/h2d/compute/
-            # d2h stage marks so device_s stops being one opaque number
+            # d2h stage marks so device_s stops being one opaque number.
+            # The FIRST member's request/trace context is re-bound too, so
+            # outbound storage calls inside batch_fn carry that request's
+            # trace id across the process boundary (wave-mates' traces
+            # still get the device events through their own meta)
             with device_obs.wave_timeline() as timeline:
                 with deadline_scope(absolute=wave_deadline):
-                    results = self._call_batch_fn(items)
+                    with _wave_context(live[0]):
+                        results = self._call_batch_fn(items)
             device_s = time.perf_counter() - t_dispatch
             self._m_device_time.observe(device_s)
             breakdown = self._observe_timeline(timeline, device_s)
             # fill per-item timing meta BEFORE resolving the futures:
             # call_soon_threadsafe orders these writes before the
             # submitter's read on the loop thread
-            for _, _, t_enq, _, meta, _ in live:
+            for _, _, t_enq, _, meta, _, _ in live:
                 if meta is not None:
                     meta["queue_wait_s"] = round(t_dispatch - t_enq, 6)
                     meta["device_s"] = round(device_s, 6)
                     meta["device_breakdown"] = breakdown
                     meta["wave_device"] = timeline.device
+                    #: wall-clock dispatch time — the distributed timeline's
+                    #: anchor for the wave's device-track events
+                    meta["wave_t0"] = round(wave_t0, 6)
                     if timeline.fn:
                         meta["wave_fn"] = timeline.fn
                         meta["wave_flops"] = timeline.flops
@@ -362,6 +390,9 @@ class MicroBatcher:
                     if timeline.shards:
                         # sharded wave: which devices held which bytes
                         meta["wave_shards"] = timeline.shards
+                    if timeline.shard_seconds:
+                        # ... and each device's own settle clock
+                        meta["wave_shard_seconds"] = timeline.shard_seconds
                     meta["wave_size"] = len(items)
                     meta["wave_seq"] = wave_seq
                     meta["wave_request_ids"] = rids
@@ -412,7 +443,8 @@ class MicroBatcher:
             wave_error,
         )
         now = _deadline_now()
-        for item, fut, t_enq, _rid, meta, dl in live:
+        for entry in live:
+            item, fut, t_enq, _rid, meta, dl, _tc = entry
             if self._closed:
                 _post_one(fut, error=wave_error)
                 continue
@@ -428,10 +460,12 @@ class MicroBatcher:
                 )
                 continue
             t0 = time.perf_counter()
+            t0_wall = time.time()
             try:
                 with device_obs.wave_timeline() as timeline:
                     with deadline_scope(absolute=dl):
-                        result = self._call_batch_fn([item])[0]
+                        with _wave_context(entry):
+                            result = self._call_batch_fn([item])[0]
             except Exception as e:
                 _post_one(fut, error=e)
                 continue
@@ -442,12 +476,15 @@ class MicroBatcher:
                 meta["device_s"] = round(solo_s, 6)
                 meta["device_breakdown"] = breakdown
                 meta["wave_device"] = timeline.device
+                meta["wave_t0"] = round(t0_wall, 6)
                 if timeline.fn:
                     meta["wave_fn"] = timeline.fn
                     meta["wave_flops"] = timeline.flops
                     meta["wave_bytes"] = timeline.bytes
                 if timeline.shards:
                     meta["wave_shards"] = timeline.shards
+                if timeline.shard_seconds:
+                    meta["wave_shard_seconds"] = timeline.shard_seconds
                 meta["wave_size"] = 1
                 meta["wave_seq"] = wave_seq
                 meta["solo_retry"] = True
@@ -462,6 +499,25 @@ class MicroBatcher:
             loop.call_soon_threadsafe(_resolve_wave, futures, results, error)
         except RuntimeError:
             pass  # loop already closed during shutdown
+
+
+@contextlib.contextmanager
+def _wave_context(entry: tuple):
+    """Re-bind one wave member's request + trace context around a dispatch
+    on the worker thread, so outbound calls inside ``batch_fn`` (storage
+    daemon round trips) propagate that request's ids across the process
+    boundary.  No-op for submitters that carried no context."""
+    _, _, _, rid, _, _, (tid, sid) = entry
+    if not rid and not tid:
+        yield
+        return
+    tokens = set_request_context(rid, tid)
+    ptoken = bind_parent_span(sid)
+    try:
+        yield
+    finally:
+        reset_parent_span(ptoken)
+        reset_request_context(tokens)
 
 
 def _post_one(fut: asyncio.Future, result=None, error=None) -> None:

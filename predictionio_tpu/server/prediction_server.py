@@ -43,6 +43,7 @@ from predictionio_tpu.lifecycle.generations import (
     CorruptModelError,
     GenerationStore,
 )
+from predictionio_tpu.obs.disttrace import note_wave_events
 from predictionio_tpu.obs.flight import annotate
 from predictionio_tpu.obs.http import add_observability_routes
 from predictionio_tpu.obs.logging import get_request_id
@@ -821,10 +822,14 @@ def create_prediction_server_app(
             meta: dict[str, Any] = {}
             route_info: tuple[str, str] | None = None
             try:
-                with trace("serve.microbatch", record=False):
+                with trace("serve.microbatch", record=False) as mb_span:
                     status, value, degraded, route_info = (
                         await batcher.submit(payload, meta)
                     )
+                    # the wave's device-stage + per-shard events become
+                    # device-track fragments of THIS request's trace,
+                    # parented under the serve span (obs/disttrace.py)
+                    note_wave_events(meta, parent=mb_span)
             except LoadShed as e:
                 # bounded queue: shed instead of letting the backlog grow —
                 # clients get an honest 503 + Retry-After

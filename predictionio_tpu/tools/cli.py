@@ -1041,6 +1041,54 @@ def do_check(args) -> int:
     return 1 if report.findings else 0
 
 
+def do_trace(args) -> int:
+    """`pio trace <id> --from URL,URL`: assemble one cross-process trace.
+
+    Fetches every named process's ``/spans.json?trace_id=`` fragment set
+    (clock-aligned from the request/response timestamps), folds in recorded
+    files and/or this process's own store, and merges into a single
+    host+device timeline — rendered as an indented text waterfall (default),
+    plain JSON (``--json``), or Chrome trace-event JSON loadable by
+    Perfetto / chrome://tracing (``--perfetto OUT``).  Exit 1 when no
+    usable fragments exist for the trace."""
+    from predictionio_tpu.obs.timeline import TraceAssemblyError, collect_trace
+
+    urls = [
+        u.strip()
+        for part in (args.from_urls or [])
+        for u in part.split(",")
+        if u.strip()
+    ]
+    files = list(args.file or [])
+    try:
+        tl = collect_trace(
+            args.trace_id,
+            urls=urls,
+            files=files,
+            include_local=args.local or not (urls or files),
+            access_key=args.access_key,
+        )
+    except TraceAssemblyError as e:
+        print(f"trace assembly failed: {e}", file=sys.stderr)
+        return 1
+    if args.perfetto:
+        body = json.dumps(tl.to_chrome_trace())
+        if args.perfetto == "-":
+            print(body)
+        else:
+            Path(args.perfetto).write_text(body)
+            print(
+                f"wrote {tl.span_count} span(s) across "
+                f"{len(tl.processes)} process(es) to {args.perfetto} "
+                "(open in https://ui.perfetto.dev or chrome://tracing)"
+            )
+    elif args.json:
+        _print(tl.to_dict())
+    else:
+        print(tl.render_text())
+    return 0
+
+
 def do_bench(args) -> int:
     """`pio bench --compare PREV.json [CURRENT.json]`: the perf-regression
     gate over two BENCH json lines (bench.py output).
@@ -1381,6 +1429,55 @@ def build_parser() -> argparse.ArgumentParser:
     tp.add_argument("name", nargs="?")
     tp.add_argument("directory", nargs="?")
     tp.set_defaults(fn=do_template)
+
+    tc = sub.add_parser(
+        "trace",
+        description="Assemble one cross-process trace: fetch span "
+        "fragments from every named daemon's /spans.json?trace_id=, "
+        "clock-align them, and merge into a single host+device timeline "
+        "(text waterfall, JSON, or Perfetto/Chrome trace-event JSON).",
+    )
+    tc.add_argument("trace_id", help="the X-Pio-Trace-Id to assemble")
+    tc.add_argument(
+        "--from",
+        dest="from_urls",
+        action="append",
+        default=None,
+        metavar="URL[,URL]",
+        help="server base URLs to fetch /spans.json from (repeatable, "
+        "comma-separable); dead daemons cost their fragments, not the "
+        "assembly",
+    )
+    tc.add_argument(
+        "--file",
+        action="append",
+        default=None,
+        metavar="PATH",
+        help="recorded /spans.json body (or bare fragment list) to fold in "
+        "(repeatable)",
+    )
+    tc.add_argument(
+        "--local",
+        action="store_true",
+        help="include this process's own fragment store (default when no "
+        "--from/--file is given)",
+    )
+    tc.add_argument(
+        "--json", action="store_true", help="assembled tree as JSON"
+    )
+    tc.add_argument(
+        "--perfetto",
+        metavar="OUT",
+        default=None,
+        help="write Chrome trace-event JSON to OUT ('-' for stdout); load "
+        "in https://ui.perfetto.dev or chrome://tracing",
+    )
+    tc.add_argument(
+        "--access-key",
+        default=None,
+        help="access key for key-gated servers (sent as a Bearer header)",
+    )
+    tc.set_defaults(fn=do_trace)
 
     mt = sub.add_parser("metrics")
     mt.add_argument(

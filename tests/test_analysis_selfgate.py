@@ -155,6 +155,70 @@ def test_device_module_lint_clean_with_zero_pragmas():
     assert baselined == []
 
 
+def test_disttrace_modules_lint_clean_with_zero_pragmas():
+    """The distributed-tracing pair — disttrace.py (fragment collection on
+    every finished root span) and timeline.py (the assembler) — runs on
+    every traced request and inside the collector tooling: it must be `pio
+    check`-clean with NO pragma suppressions and NO baseline entries —
+    same bar as the rest of obs/."""
+    files = [
+        PACKAGE / "obs" / "disttrace.py",
+        PACKAGE / "obs" / "timeline.py",
+    ]
+    report = analyze_paths(files, root=REPO_ROOT)
+    assert report.errors == []
+    assert report.findings == [], "\n".join(f.text() for f in report.findings)
+    assert report.pragma_suppressed == 0
+    names = {
+        "predictionio_tpu/obs/disttrace.py",
+        "predictionio_tpu/obs/timeline.py",
+    }
+    baselined = [
+        e for e in Baseline.load(BASELINE).entries if e.file in names
+    ]
+    assert baselined == []
+
+
+def test_trace_assemble_smoke():
+    """Tier-1 smoke of the trace assembler's CI-gateable entry point:
+    `pio trace --json` round-trips the recorded two-process fragment set in
+    tests/fixtures/disttrace/ — deterministic, no servers needed.  The full
+    CLI contract lives in tests/test_disttrace.py."""
+    import contextlib
+    import io
+    import json
+
+    from predictionio_tpu.tools.cli import main
+
+    fixture = (
+        REPO_ROOT / "tests" / "fixtures" / "disttrace" / "fragments.json"
+    )
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = main(["trace", "fixture01", "--file", str(fixture), "--json"])
+    assert rc == 0
+    body = json.loads(out.getvalue())
+    assert body["span_count"] == 5
+    assert body["processes"] == [
+        "predictionserver:4242", "storage-server:4243",
+    ]
+    # the daemon's root hangs under the serving process's call-site span
+    root = body["spans"][0]
+    mb = root["children"][0]
+    storage = next(
+        c for c in mb["children"] if c["name"] == "storage.remote"
+    )
+    assert [c["name"] for c in storage["children"]] == [
+        "http.storage-server"
+    ]
+    # an unknown trace id is a loud exit-1, not an empty render
+    with contextlib.redirect_stdout(io.StringIO()), \
+            contextlib.redirect_stderr(io.StringIO()):
+        assert (
+            main(["trace", "nope", "--file", str(fixture), "--json"]) == 1
+        )
+
+
 def test_bench_compare_smoke():
     """Tier-1 smoke of the perf-regression gate: a synthetic current/prev
     pair drives `pio bench --compare` through the real CLI — deterministic,

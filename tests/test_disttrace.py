@@ -1,0 +1,1376 @@
+"""Distributed tracing & unified timeline (ISSUE 9): trace-context
+propagation across daemons, span-fragment stores + ``/spans.json``, the
+cross-process assembler with clock alignment and Chrome-trace/Perfetto
+export, wave device-track events, the straggler board + ``/shards.json``,
+SLO trace exemplars, flight trace filtering, and the `pio trace` verb.
+
+The chaos-style cross-process e2e (real `pio deploy` + SIGKILL-able storage
+daemon) and the 8-virtual-device straggler acceptance live at the bottom.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs import disttrace as dt
+from predictionio_tpu.obs import timeline as tlm
+from predictionio_tpu.obs.logging import (
+    reset_request_context,
+    set_request_context,
+)
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.tracing import clear_traces, trace
+from predictionio_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _isolate_trace_globals():
+    dt.FRAGMENTS.clear()
+    clear_traces()
+    faults.clear()
+    yield
+    dt.FRAGMENTS.clear()
+    clear_traces()
+    faults.clear()
+
+
+@pytest.fixture()
+def bound_trace():
+    """A request context bound to a fixed trace id."""
+    tokens = set_request_context("rid1", "trace1")
+    yield "trace1"
+    reset_request_context(tokens)
+
+
+def _get(url: str, timeout: float = 10.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+# ---------------------------------------------------------------------------
+# propagation
+
+
+class TestPropagation:
+    def test_no_header_starts_trace_under_request_id(self):
+        tid, parent = dt.adopt_trace_context({}, "req42")
+        assert tid == "req42" and parent is None
+
+    def test_headers_adopted_case_tolerant(self):
+        for headers in (
+            {"X-Pio-Trace-Id": "t9", "X-Pio-Parent-Span": "abc"},
+            {"x-pio-trace-id": "t9", "x-pio-parent-span": "abc"},
+        ):
+            assert dt.adopt_trace_context(headers, "rid") == ("t9", "abc")
+
+    def test_hostile_header_lengths_bounded(self):
+        tid, parent = dt.adopt_trace_context(
+            {
+                "X-Pio-Trace-Id": "T" * 500,
+                "X-Pio-Parent-Span": "P" * 500,
+            },
+            "rid",
+        )
+        assert len(tid) == dt._ID_MAX
+        assert parent is None  # an oversized parent id is dropped, not kept
+
+    def test_propagation_headers_empty_without_trace(self):
+        assert dt.propagation_headers() == {}
+
+    def test_propagation_headers_use_innermost_open_span(self, bound_trace):
+        with trace("outer", registry=MetricsRegistry()):
+            with trace("inner", registry=MetricsRegistry()) as inner:
+                h = dt.propagation_headers()
+        assert h[dt.TRACE_ID_HEADER] == "trace1"
+        assert h[dt.PARENT_SPAN_HEADER] == inner.span_id
+
+    def test_adopted_parent_used_when_no_span_open(self, bound_trace):
+        token = dt.bind_parent_span("ext1")
+        try:
+            assert dt.current_trace_context() == ("trace1", "ext1")
+            h = dt.propagation_headers()
+            assert h[dt.PARENT_SPAN_HEADER] == "ext1"
+        finally:
+            dt.reset_parent_span(token)
+
+    def test_span_ids_are_16_hex(self):
+        sid = dt.new_span_id()
+        assert len(sid) == 16
+        int(sid, 16)
+
+
+# ---------------------------------------------------------------------------
+# fragment store
+
+
+class TestFragmentStore:
+    def test_add_and_fetch(self):
+        s = dt.FragmentStore()
+        s.add("t1", {"span_id": "a"})
+        s.add("t1", {"span_id": "b"})
+        assert [f["span_id"] for f in s.fragments("t1")] == ["a", "b"]
+        assert s.fragments("missing") == []
+
+    def test_lru_eviction_keeps_newest_touched(self):
+        s = dt.FragmentStore(max_traces=2)
+        s.add("t1", {"span_id": "a"})
+        s.add("t2", {"span_id": "b"})
+        s.add("t1", {"span_id": "c"})  # touch t1: t2 is now oldest
+        s.add("t3", {"span_id": "d"})
+        assert s.fragments("t2") == []
+        assert len(s.fragments("t1")) == 2
+        assert s.trace_ids() == ["t3", "t1"]
+
+    def test_per_trace_span_cap(self):
+        s = dt.FragmentStore(max_spans_per_trace=3)
+        s.add_many("t1", [{"span_id": str(i)} for i in range(10)])
+        assert len(s.fragments("t1")) == 3
+
+    def test_snapshot_listing_and_trace_body(self):
+        s = dt.FragmentStore()
+        s.add("t1", {"span_id": "a"})
+        listing = s.snapshot()
+        assert listing["traces"] == {"t1": 1}
+        assert ":" in listing["process"] and listing["now"] > 0
+        body = s.snapshot(trace_id="t1")
+        assert body["trace_id"] == "t1"
+        assert body["spans"] == [{"span_id": "a"}]
+
+
+# ---------------------------------------------------------------------------
+# span trees -> fragments (tracing integration)
+
+
+class TestSpanCollection:
+    def test_root_tree_flattens_with_parent_links(self, bound_trace):
+        reg = MetricsRegistry()
+        token = dt.bind_parent_span("caller9")
+        try:
+            with trace("root", registry=reg) as root:
+                with trace("child", registry=reg) as child:
+                    pass
+        finally:
+            dt.reset_parent_span(token)
+        frags = {f["span_id"]: f for f in dt.FRAGMENTS.fragments("trace1")}
+        assert set(frags) == {root.span_id, child.span_id}
+        # the ROOT parents under the cross-process caller, the child in-tree
+        assert frags[root.span_id]["parent_id"] == "caller9"
+        assert frags[child.span_id]["parent_id"] == root.span_id
+        assert frags[root.span_id]["request_id"] == "rid1"
+        assert frags[root.span_id]["process"] == dt.process_label()
+        assert frags[child.span_id]["start_ts"] > 0
+
+    def test_untraced_spans_not_collected(self):
+        with trace("loose", registry=MetricsRegistry()):
+            pass
+        assert dt.FRAGMENTS.trace_ids() == []
+
+    def test_error_and_tags_ride_into_fragment(self, bound_trace):
+        with pytest.raises(RuntimeError):
+            with trace("boom", registry=MetricsRegistry()) as sp:
+                sp.tags = {"route": "/q"}
+                raise RuntimeError("kaput")
+        (frag,) = dt.FRAGMENTS.fragments("trace1")
+        assert "kaput" in frag["error"]
+        assert frag["tags"]["route"] == "/q"
+
+    def test_record_fragment_standalone(self, bound_trace):
+        frag = dt.record_fragment(
+            "train.step", 100.0, 0.5, track="train:2dev", tags={"it": 3}
+        )
+        assert frag is not None
+        (stored,) = dt.FRAGMENTS.fragments("trace1")
+        assert stored["name"] == "train.step"
+        assert stored["track"] == "train:2dev"
+        assert stored["duration_s"] == 0.5
+
+    def test_record_fragment_noop_without_trace(self):
+        assert dt.record_fragment("x", 0.0, 1.0) is None
+        assert dt.FRAGMENTS.trace_ids() == []
+
+
+# ---------------------------------------------------------------------------
+# wave meta -> device-track events
+
+
+class TestNoteWaveEvents:
+    def _meta(self, **over):
+        meta = {
+            "wave_t0": 1000.0,
+            "wave_seq": 7,
+            "wave_size": 4,
+            "wave_device": "cpu:0",
+            "device_breakdown": {
+                "host_gather": 0.01,
+                "h2d": 0.002,
+                "compute": 0.03,
+                "d2h": 0.004,
+                "other": 0.001,
+            },
+        }
+        meta.update(over)
+        return meta
+
+    def test_stages_laid_end_to_end(self, bound_trace):
+        class Parent:
+            span_id = "pp"
+
+        dt.note_wave_events(self._meta(), parent=Parent())
+        frags = sorted(
+            dt.FRAGMENTS.fragments("trace1"), key=lambda f: f["start_ts"]
+        )
+        assert [f["name"] for f in frags] == [
+            "wave.host_gather", "wave.h2d", "wave.compute", "wave.d2h",
+        ]
+        # end-to-end layout in execution order from the dispatch timestamp
+        assert frags[0]["start_ts"] == 1000.0
+        assert frags[1]["start_ts"] == pytest.approx(1000.01)
+        assert frags[2]["start_ts"] == pytest.approx(1000.012)
+        assert frags[3]["start_ts"] == pytest.approx(1000.042)
+        for f in frags:
+            assert f["track"] == "device:cpu:0"
+            assert f["parent_id"] == "pp"
+            assert f["tags"]["wave_seq"] == 7
+
+    def test_unstaged_wave_gets_one_device_event(self, bound_trace):
+        meta = self._meta(device_breakdown={"other": 0.02})
+        dt.note_wave_events(meta)
+        (frag,) = dt.FRAGMENTS.fragments("trace1")
+        assert frag["name"] == "wave.device"
+        assert frag["duration_s"] == pytest.approx(0.02)
+
+    def test_shard_settles_emit_per_device_tracks(self, bound_trace):
+        meta = self._meta(
+            wave_shard_seconds={"cpu:0": 0.03, "cpu:1": 0.08}
+        )
+        dt.note_wave_events(meta)
+        shard = [
+            f
+            for f in dt.FRAGMENTS.fragments("trace1")
+            if f["name"] == "wave.shard"
+        ]
+        assert {f["track"] for f in shard} == {
+            "device:cpu:0", "device:cpu:1",
+        }
+        # shard settles start at the compute stage (after gather + h2d)
+        assert all(
+            f["start_ts"] == pytest.approx(1000.012) for f in shard
+        )
+
+    def test_noop_without_trace_or_t0(self):
+        dt.note_wave_events(self._meta())  # no trace bound
+        assert dt.FRAGMENTS.trace_ids() == []
+        tokens = set_request_context("r", "t")
+        try:
+            dt.note_wave_events({"device_breakdown": {"compute": 1.0}})
+        finally:
+            reset_request_context(tokens)
+        assert dt.FRAGMENTS.trace_ids() == []
+
+    def test_hostile_meta_never_raises(self, bound_trace):
+        dt.note_wave_events(
+            {"wave_t0": 1.0, "device_breakdown": "not-a-mapping"}
+        )
+
+
+# ---------------------------------------------------------------------------
+# clock alignment + assembly
+
+
+class TestClockAlignment:
+    def test_midpoint_estimate(self):
+        # server clock 5 s ahead: sampled at the midpoint of a 2 s RTT
+        assert tlm.estimate_offset(105.0, 99.0, 101.0) == pytest.approx(5.0)
+
+    def test_applied_to_start_ts(self):
+        bodies = [
+            {
+                "process": "a:1", "_offset_s": 0.0, "_source": "a",
+                "spans": [
+                    {"trace_id": "t", "span_id": "r", "name": "root",
+                     "start_ts": 10.0, "duration_s": 1.0}
+                ],
+            },
+            {
+                "process": "b:2", "_offset_s": 5.0, "_source": "b",
+                "spans": [
+                    {"trace_id": "t", "span_id": "c", "parent_id": "r",
+                     "name": "child", "start_ts": 15.2, "duration_s": 0.5}
+                ],
+            },
+        ]
+        tl = tlm.assemble(bodies, "t")
+        # b's clock was 5 s ahead: its span lands 0.2 s into the trace
+        child = tl.nodes["c"]
+        assert child.start_s - tl.t0 == pytest.approx(0.2)
+        assert tl.offsets["b"] == 5.0
+
+
+def _bodies():
+    return [
+        {
+            "process": "front:1", "_offset_s": 0.0, "_source": "front",
+            "spans": [
+                {"trace_id": "t", "span_id": "r", "name": "http.front",
+                 "start_ts": 100.0, "duration_s": 0.1,
+                 "request_id": "rid"},
+                {"trace_id": "t", "span_id": "s", "parent_id": "r",
+                 "name": "storage.remote", "start_ts": 100.01,
+                 "duration_s": 0.05},
+                {"trace_id": "t", "span_id": "d", "parent_id": "r",
+                 "name": "wave.compute", "start_ts": 100.02,
+                 "duration_s": 0.03, "track": "device:cpu:0",
+                 "tags": {"stage": "compute"}},
+                {"trace_id": "other", "span_id": "x", "name": "noise",
+                 "start_ts": 1.0, "duration_s": 1.0},
+            ],
+        },
+        {
+            "process": "daemon:2", "_offset_s": 0.0, "_source": "daemon",
+            "spans": [
+                {"trace_id": "t", "span_id": "k", "parent_id": "s",
+                 "name": "http.storage", "start_ts": 100.02,
+                 "duration_s": 0.03},
+            ],
+        },
+    ]
+
+
+class TestAssemble:
+    def test_cross_process_tree(self):
+        tl = tlm.assemble(_bodies(), "t")
+        assert tl.processes == ["front:1", "daemon:2"]
+        assert tl.span_count == 4  # the other-trace fragment is excluded
+        (root,) = tl.roots
+        assert root.name == "http.front"
+        # the daemon's root hangs under the client call-site span
+        storage = next(c for c in root.children if c.name == "storage.remote")
+        assert [c.name for c in storage.children] == ["http.storage"]
+        assert [n.name for n in tl.device_events()] == ["wave.compute"]
+
+    def test_orphaned_fragment_kept_as_flagged_root(self):
+        bodies = _bodies()
+        # the front end never exported (SIGKILLed): only the daemon's
+        # fragment remains, naming a parent that never arrived
+        tl = tlm.assemble(bodies[1:], "t")
+        (root,) = tl.roots
+        assert root.name == "http.storage" and root.orphan
+        assert "orphan" in tl.to_dict()["spans"][0]
+
+    def test_duplicate_span_ids_keep_first(self):
+        bodies = _bodies()
+        bodies.append(dict(bodies[0]))  # same process fetched twice
+        tl = tlm.assemble(bodies, "t")
+        assert tl.span_count == 4
+
+    def test_no_fragments_raises(self):
+        with pytest.raises(tlm.TraceAssemblyError):
+            tlm.assemble(_bodies(), "unknown-trace")
+
+    def test_render_text(self):
+        txt = tlm.assemble(_bodies(), "t").render_text()
+        assert "trace t — 2 process(es), 4 span(s)" in txt
+        assert "http.front" in txt and "http.storage" in txt
+        assert "~wave.compute" in txt  # device events marked distinctly
+        orphan_txt = tlm.assemble(_bodies()[1:], "t").render_text()
+        assert "orphaned" in orphan_txt
+
+    def test_to_dict_relative_times(self):
+        d = tlm.assemble(_bodies(), "t").to_dict()
+        assert d["trace_id"] == "t"
+        assert d["spans"][0]["start_s"] == 0.0
+        assert d["span_count"] == 4
+
+
+class TestChromeTrace:
+    def test_perfetto_object_shape(self):
+        ct = tlm.assemble(_bodies(), "t").to_chrome_trace()
+        json.loads(json.dumps(ct))  # serializable as-is
+        events = ct["traceEvents"]
+        procs = {
+            e["args"]["name"]: e["pid"]
+            for e in events
+            if e["ph"] == "M" and e["name"] == "process_name"
+        }
+        assert set(procs) == {"front:1", "daemon:2"}
+        threads = {
+            (e["pid"], e["args"]["name"])
+            for e in events
+            if e["ph"] == "M" and e["name"] == "thread_name"
+        }
+        # the front end has a span lane AND a device lane; the daemon one
+        assert (procs["front:1"], "spans") in threads
+        assert (procs["front:1"], "device:cpu:0") in threads
+        assert (procs["daemon:2"], "spans") in threads
+        xs = [e for e in events if e["ph"] == "X"]
+        assert len(xs) == 4
+        by_name = {e["name"]: e for e in xs}
+        assert by_name["wave.compute"]["cat"] == "device"
+        assert by_name["http.front"]["cat"] == "span"
+        assert by_name["http.front"]["ts"] == 0.0
+        assert by_name["http.front"]["dur"] == pytest.approx(0.1 * 1e6)
+        assert by_name["wave.compute"]["ts"] == pytest.approx(0.02 * 1e6)
+        assert by_name["http.front"]["args"]["request_id"] == "rid"
+        assert by_name["wave.compute"]["args"]["stage"] == "compute"
+
+
+class TestFragmentFilesAndCollect:
+    def test_load_body_list_and_bare_fragments(self, tmp_path):
+        body = _bodies()[0]
+        p1 = tmp_path / "body.json"
+        p1.write_text(json.dumps({k: v for k, v in body.items()
+                                  if not k.startswith("_")}))
+        (loaded,) = tlm.load_fragment_file(str(p1))
+        assert loaded["_offset_s"] == 0.0 and loaded["_source"] == str(p1)
+        p2 = tmp_path / "bodies.json"
+        p2.write_text(json.dumps(
+            [{k: v for k, v in b.items() if not k.startswith("_")}
+             for b in _bodies()]
+        ))
+        assert len(tlm.load_fragment_file(str(p2))) == 2
+        p3 = tmp_path / "bare.json"
+        p3.write_text(json.dumps(body["spans"]))
+        (wrapped,) = tlm.load_fragment_file(str(p3))
+        assert len(wrapped["spans"]) == 4
+        p4 = tmp_path / "bad.json"
+        p4.write_text('"nope"')
+        with pytest.raises(tlm.TraceAssemblyError):
+            tlm.load_fragment_file(str(p4))
+
+    def test_collect_trace_tolerates_dead_sources(self, tmp_path):
+        p = tmp_path / "frags.json"
+        p.write_text(json.dumps(
+            [{k: v for k, v in b.items() if not k.startswith("_")}
+             for b in _bodies()]
+        ))
+        tl = tlm.collect_trace(
+            "t",
+            urls=["http://127.0.0.1:2"],  # nothing listens here
+            files=[str(p)],
+            timeout=0.5,
+        )
+        assert tl.span_count == 4
+        assert len(tl.source_errors) == 1
+        assert "127.0.0.1:2" in tl.source_errors[0]
+
+    def test_collect_trace_local_store(self, bound_trace):
+        with trace("local.root", registry=MetricsRegistry()):
+            pass
+        tl = tlm.collect_trace("trace1", include_local=True)
+        assert [r.name for r in tl.roots] == ["local.root"]
+
+
+# ---------------------------------------------------------------------------
+# HTTP surfaces: /spans.json on every daemon, trace exemplars, flight filter
+
+
+def _serve(app):
+    from predictionio_tpu.server.httpd import AppServer
+
+    server = AppServer(app, "127.0.0.1", 0)
+    server.start_background()
+    return server
+
+
+class TestSpansRoute:
+    def test_spans_json_serves_fragments(self, bound_trace):
+        from predictionio_tpu.obs.http import add_observability_routes
+        from predictionio_tpu.server.httpd import HTTPApp
+
+        app = add_observability_routes(HTTPApp("spanstest"))
+        with trace("served.root", registry=MetricsRegistry()):
+            pass
+        server = _serve(app)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            status, body = _get(base + "/spans.json?trace_id=trace1")
+            assert status == 200
+            assert body["trace_id"] == "trace1"
+            assert [s["name"] for s in body["spans"]] == ["served.root"]
+            assert body["now"] == pytest.approx(time.time(), abs=30)
+            status, listing = _get(base + "/spans.json")
+            assert status == 200 and "trace1" in listing["traces"]
+            status, _ = _get(base + "/spans.json?limit=zap")
+            assert status == 400
+        finally:
+            server.shutdown()
+
+    def test_spans_json_gated_by_app_key(self):
+        from predictionio_tpu.obs.http import add_observability_routes
+        from predictionio_tpu.server.httpd import HTTPApp
+
+        app = add_observability_routes(
+            HTTPApp("gated", access_key="sekrit")
+        )
+        server = _serve(app)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            status, _ = _get(base + "/spans.json")
+            assert status == 401
+            status, _ = _get(base + "/spans.json?accessKey=sekrit")
+            assert status == 200
+        finally:
+            server.shutdown()
+
+    def test_fetch_spans_aligns_clock(self, bound_trace):
+        from predictionio_tpu.obs.http import add_observability_routes
+        from predictionio_tpu.server.httpd import HTTPApp
+
+        app = add_observability_routes(HTTPApp("aligntest"))
+        with trace("r", registry=MetricsRegistry()):
+            pass
+        server = _serve(app)
+        try:
+            body = tlm.fetch_spans(
+                f"http://127.0.0.1:{server.port}", "trace1"
+            )
+            # same host, same clock: the estimated offset is ~RTT-bounded
+            assert abs(body["_offset_s"]) < 5.0
+            assert body["spans"]
+        finally:
+            server.shutdown()
+
+
+class TestSLOExemplars:
+    def test_breaching_requests_record_trace_exemplars(self):
+        from predictionio_tpu.obs.slo import SLOTracker
+
+        t = SLOTracker(latency_threshold_s=0.1)
+        t.record(True, 0.01, trace_id="fast")  # healthy: no exemplar
+        t.record(True, 0.5, trace_id="slow-trace")
+        t.record(False, 0.01, trace_id="err-trace")
+        t.record(False, 0.01)  # no trace id: nothing to link
+        ex = t.snapshot()["exemplars"]
+        assert [(e["trace_id"], e["reason"]) for e in ex] == [
+            ("err-trace", "error"),
+            ("slow-trace", "slow"),
+        ]
+
+    def test_exemplar_ring_bounded(self):
+        from predictionio_tpu.obs.slo import EXEMPLAR_CAPACITY, SLOTracker
+
+        t = SLOTracker()
+        for i in range(EXEMPLAR_CAPACITY + 10):
+            t.record(False, 0.01, trace_id=f"t{i}")
+        ex = t.snapshot()["exemplars"]
+        assert len(ex) == EXEMPLAR_CAPACITY
+        assert ex[0]["trace_id"] == f"t{EXEMPLAR_CAPACITY + 9}"
+
+
+class TestFlightTraceFilter:
+    def test_snapshot_filters_by_trace_id(self):
+        from predictionio_tpu.obs.flight import FlightRecorder
+
+        fr = FlightRecorder(keep_slowest=8)
+        fr.record({"request_id": "r1", "trace_id": "tA",
+                   "duration_s": 0.5, "status": 200})
+        fr.record({"request_id": "r2", "trace_id": "tB",
+                   "duration_s": 0.9, "status": 200})
+        snap = fr.snapshot(trace_id="tB")
+        assert [e["request_id"] for e in snap["slowest"]] == ["r2"]
+        assert fr.snapshot(trace_id="zz")["slowest"] == []
+
+
+# ---------------------------------------------------------------------------
+# RemoteClient propagation: daemon spans parent under the call site
+
+
+class TestRemoteClientPropagation:
+    @pytest.fixture()
+    def daemon(self, tmp_path):
+        from predictionio_tpu.server.storage_server import StorageServer
+
+        s = StorageServer(tmp_path / "root", host="127.0.0.1", port=0)
+        s.start_background()
+        yield s
+        s.shutdown()
+
+    def test_daemon_spans_parent_under_client_call(
+        self, daemon, bound_trace
+    ):
+        """The satellite regression: a storage round trip made inside a
+        request context yields a daemon-side root fragment whose parent_id
+        is the client's ``storage.remote`` span — parented, not orphaned."""
+        from predictionio_tpu.data.storage.remote_backend import RemoteClient
+
+        c = RemoteClient(f"http://127.0.0.1:{daemon.port}", timeout=5.0)
+        with trace("serve.call", registry=MetricsRegistry()) as serve_sp:
+            assert c.json("GET", "/v1/ping")["status"] == "alive"
+        frags = dt.FRAGMENTS.fragments("trace1")
+        by_name = {}
+        for f in frags:
+            by_name.setdefault(f["name"], f)
+        storage_sp = by_name["storage.remote"]
+        daemon_root = by_name["http.storage-server"]
+        assert storage_sp["parent_id"] == serve_sp.span_id
+        assert storage_sp["tags"]["call"] == "GET /v1/ping"
+        # the cross-process link: daemon root -> client call-site span
+        assert daemon_root["parent_id"] == storage_sp["span_id"]
+        assert daemon_root["trace_id"] == "trace1"
+        assert daemon_root["request_id"] == "rid1"
+        # and the assembled tree walks the boundary without orphans
+        tl = tlm.collect_trace("trace1", include_local=True)
+        (root,) = tl.roots
+        assert root.name == "serve.call" and not root.orphan
+        storage_node = root.children[0]
+        assert [c_.name for c_ in storage_node.children] == [
+            "http.storage-server"
+        ]
+
+    def test_untraced_client_sends_no_trace_headers(self, daemon):
+        """Without a bound trace the client forwards nothing: the daemon
+        starts its OWN trace (every request is traceable without opt-in)
+        and its root adopts no cross-process parent."""
+        from predictionio_tpu.data.storage.remote_backend import RemoteClient
+
+        c = RemoteClient(f"http://127.0.0.1:{daemon.port}", timeout=5.0)
+        assert c.json("GET", "/v1/ping")["status"] == "alive"
+        roots = [
+            f
+            for tid in dt.FRAGMENTS.trace_ids()
+            for f in dt.FRAGMENTS.fragments(tid)
+            if f["name"].startswith("http.")
+        ]
+        assert roots and all("parent_id" not in f for f in roots)
+
+
+# ---------------------------------------------------------------------------
+# straggler board
+
+
+class TestStragglerBoard:
+    def _board(self, **kw):
+        from predictionio_tpu.obs.device import StragglerBoard
+
+        kw.setdefault("registry", MetricsRegistry())
+        kw.setdefault("skew_threshold", 0.5)
+        kw.setdefault("patience", 3)
+        return StragglerBoard(**kw)
+
+    def test_skew_is_max_over_median(self):
+        b = self._board()
+        skew = b.record_wave(
+            "fn", {"cpu:0": 0.10, "cpu:1": 0.10, "cpu:2": 0.10,
+                   "cpu:3": 0.25}
+        )
+        assert skew == pytest.approx(0.25 / 0.10 - 1.0)
+        snap = b.snapshot()["functions"]["fn"]
+        assert snap["last_max_device"] == "cpu:3"
+        assert snap["straggler"] is None  # one wave is noise, not a flag
+
+    def test_single_device_wave_ignored(self):
+        b = self._board()
+        assert b.record_wave("fn", {"cpu:0": 1.0}) == 0.0
+        assert "fn" not in b.snapshot()["functions"]
+
+    def test_patience_flags_persistent_straggler_once(self):
+        reg = MetricsRegistry()
+        b = self._board(registry=reg)
+        secs = {"cpu:0": 0.1, "cpu:1": 0.1, "cpu:2": 0.1, "cpu:3": 0.4}
+        for _ in range(4):
+            b.record_wave("fn", secs)
+        snap = b.snapshot()["functions"]["fn"]
+        assert snap["straggler"] == "cpu:3"
+        assert snap["devices"]["cpu:3"]["slowest"] == 4
+        c = reg.get("pio_shard_straggler_total")
+        assert c.labels("fn", "cpu:3").value == 1  # flagged ONCE, not 4x
+        assert reg.get("pio_shard_skew_frac").labels("fn").value == (
+            pytest.approx(3.0)
+        )
+
+    def test_rotating_slowest_never_flags(self):
+        b = self._board()
+        devs = ["cpu:0", "cpu:1", "cpu:2", "cpu:3"]
+        for i in range(8):
+            secs = {d: 0.1 for d in devs}
+            secs[devs[i % 4]] = 0.4  # a different device each wave
+            b.record_wave("fn", secs)
+        assert b.snapshot()["functions"]["fn"]["straggler"] is None
+
+    def test_balanced_wave_resets_streak_and_flag(self):
+        b = self._board(patience=2)
+        slow = {"cpu:0": 0.1, "cpu:1": 0.4}
+        b.record_wave("fn", slow)
+        b.record_wave("fn", slow)
+        assert b.snapshot()["functions"]["fn"]["straggler"] == "cpu:1"
+        b.record_wave("fn", {"cpu:0": 0.1, "cpu:1": 0.1})
+        assert b.snapshot()["functions"]["fn"]["straggler"] is None
+
+    def test_bytes_imbalance_gauge(self):
+        reg = MetricsRegistry()
+        b = self._board(registry=reg)
+        b.record_wave(
+            "fn",
+            {"cpu:0": 0.1, "cpu:1": 0.1},
+            shard_bytes={"cpu:0": 100.0, "cpu:1": 300.0},
+        )
+        g = reg.get("pio_shard_bytes_imbalance_frac")
+        assert g.labels("fn").value == pytest.approx(300.0 / 200.0 - 1.0)
+
+
+# ---------------------------------------------------------------------------
+# per-shard settle clock on the virtual mesh
+
+
+class TestSettleShards:
+    def test_sharded_result_yields_per_device_settles(self):
+        import jax
+        import jax.numpy as jnp
+
+        from predictionio_tpu.parallel.placement import (
+            ShardPlan,
+            settle_shards,
+            shard_put,
+        )
+
+        plan = ShardPlan(axes={"model": -1}, specs={"t": ("model", None)})
+        mesh = plan.mesh(jax.devices())
+        arr, _ = shard_put(mesh, plan, "t", jnp.arange(64.0).reshape(16, 4))
+        t0 = time.perf_counter()
+        settles = settle_shards(arr, t0)
+        assert len(settles) == 8
+        assert all(s >= 0 for s in settles.values())
+
+    def test_host_array_returns_empty(self):
+        from predictionio_tpu.parallel.placement import settle_shards
+
+        assert settle_shards(np.zeros(4), time.perf_counter()) == {}
+
+    def test_fault_seam_defers_one_device(self):
+        import jax
+        import jax.numpy as jnp
+
+        from predictionio_tpu.parallel.placement import (
+            ShardPlan,
+            settle_shards,
+            shard_put,
+        )
+
+        faults.install(
+            [{"seam": "shard.settle", "kind": "latency",
+              "latency_s": 0.5, "match": "cpu:5"}]
+        )
+        plan = ShardPlan(axes={"model": -1}, specs={"t": ("model", None)})
+        mesh = plan.mesh(jax.devices())
+        arr, _ = shard_put(mesh, plan, "t", jnp.arange(64.0).reshape(16, 4))
+        settles = settle_shards(arr, time.perf_counter())
+        others = [v for k, v in settles.items() if k != "cpu:5"]
+        # the injected straggler is DEFERRED, the poll never sleeps for it
+        assert settles["cpu:5"] >= 0.5
+        assert all(v < 0.4 for v in others)
+
+
+# ---------------------------------------------------------------------------
+# `pio trace` verb
+
+
+class TestCLITrace:
+    @pytest.fixture()
+    def fragment_file(self, tmp_path):
+        p = tmp_path / "frags.json"
+        p.write_text(json.dumps(
+            [{k: v for k, v in b.items() if not k.startswith("_")}
+             for b in _bodies()]
+        ))
+        return str(p)
+
+    def test_text_render(self, fragment_file, capsys):
+        from predictionio_tpu.tools.cli import main
+
+        rc = main(["trace", "t", "--file", fragment_file])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "2 process(es)" in out and "http.storage" in out
+
+    def test_json_round_trip(self, fragment_file, capsys):
+        from predictionio_tpu.tools.cli import main
+
+        rc = main(["trace", "t", "--file", fragment_file, "--json"])
+        body = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert body["span_count"] == 4
+        assert body["processes"] == ["front:1", "daemon:2"]
+
+    def test_perfetto_export(self, fragment_file, tmp_path, capsys):
+        from predictionio_tpu.tools.cli import main
+
+        out = tmp_path / "perfetto.json"
+        rc = main([
+            "trace", "t", "--file", fragment_file, "--perfetto", str(out),
+        ])
+        assert rc == 0
+        ct = json.loads(out.read_text())
+        assert any(e["ph"] == "X" for e in ct["traceEvents"])
+        assert "perfetto" in capsys.readouterr().out
+
+    def test_unknown_trace_exits_1(self, fragment_file, capsys):
+        from predictionio_tpu.tools.cli import main
+
+        rc = main(["trace", "nope", "--file", fragment_file])
+        assert rc == 1
+        assert "failed" in capsys.readouterr().err
+
+    def test_from_url_fetch(self, bound_trace, capsys):
+        from predictionio_tpu.obs.http import add_observability_routes
+        from predictionio_tpu.server.httpd import HTTPApp
+        from predictionio_tpu.tools.cli import main
+
+        app = add_observability_routes(HTTPApp("clitest"))
+        with trace("cli.root", registry=MetricsRegistry()):
+            pass
+        server = _serve(app)
+        try:
+            rc = main([
+                "trace", "trace1",
+                "--from", f"http://127.0.0.1:{server.port}",
+                "--json",
+            ])
+            body = json.loads(capsys.readouterr().out)
+            assert rc == 0
+            assert body["spans"][0]["name"] == "cli.root"
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# dashboard: waterfall panel + assembled-view links
+
+
+class TestDashboardWaterfall:
+    @pytest.fixture()
+    def dash(self, storage):
+        from predictionio_tpu.server.dashboard import create_dashboard_app
+
+        app = create_dashboard_app(
+            storage=storage, access_key="dashkey", trace_sources=[]
+        )
+        server = _serve(app)
+        yield f"http://127.0.0.1:{server.port}"
+        server.shutdown()
+
+    def _body(self, url):
+        status, raw = _get_raw(url)
+        return status, raw.decode()
+
+    def test_waterfall_renders_lanes_and_perfetto(self, dash, bound_trace):
+        with trace("dash.root", registry=MetricsRegistry()):
+            with trace("dash.child", registry=MetricsRegistry()):
+                pass
+        status, page = self._body(
+            dash + "/trace/trace1?accessKey=dashkey"
+        )
+        assert status == 200
+        assert "dash.root" in page and "dash.child" in page
+        status, raw = _get_raw(
+            dash + "/trace/trace1?format=perfetto&accessKey=dashkey"
+        )
+        assert status == 200
+        ct = json.loads(raw)
+        assert any(e.get("ph") == "X" for e in ct["traceEvents"])
+
+    def test_unknown_trace_404s(self, dash):
+        status, _ = self._body(dash + "/trace/zzz?accessKey=dashkey")
+        assert status == 404
+
+    def test_recent_trace_rows_link_assembled_view_with_key(
+        self, dash, bound_trace
+    ):
+        """The gated-link fix, same bug class as PR 4: rows must link the
+        ASSEMBLED cross-process view and carry the access key."""
+        with trace("indexed.root", registry=MetricsRegistry()):
+            pass
+        status, page = self._body(dash + "/?accessKey=dashkey")
+        assert status == 200
+        assert "/trace/trace1?accessKey=dashkey" in page
+
+    def test_waterfall_route_gated(self, dash):
+        status, _ = self._body(dash + "/trace/trace1")
+        assert status == 401
+
+    def test_waterfall_own_links_are_well_formed_and_keyed(
+        self, dash, bound_trace
+    ):
+        """The waterfall page's raw-fragments and Perfetto links append the
+        access key with '&' onto URLs that already carry a query string —
+        a second '?' would make the server parse trace_id as
+        'trace1?accessKey=...' and 401 the click (PR 4 bug class)."""
+        with trace("linked.root", registry=MetricsRegistry()):
+            pass
+        status, page = self._body(dash + "/trace/trace1?accessKey=dashkey")
+        assert status == 200
+        assert "/spans.json?trace_id=trace1&accessKey=dashkey" in page
+        assert "/trace/trace1?format=perfetto&accessKey=dashkey" in page
+        for href in re.findall(r"href='([^']+)'", page):
+            assert href.count("?") <= 1, href
+
+
+def _get_raw(url: str, timeout: float = 10.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# ---------------------------------------------------------------------------
+# training-side step timeline (ops/als.py)
+
+
+class TestTrainingStepTimeline:
+    def _train(self, iterations=4):
+        from predictionio_tpu.ops.als import ALSParams, train_als
+
+        rng = np.random.default_rng(3)
+        ui = rng.integers(0, 20, 300).astype(np.int32)
+        ii = rng.integers(0, 15, 300).astype(np.int32)
+        r = rng.uniform(1, 5, 300).astype(np.float32)
+        train_als(
+            ui, ii, r, 20, 15,
+            ALSParams(rank=3, num_iterations=iterations, chunk_size=256),
+        )
+
+    def test_traced_train_emits_one_fragment_per_iteration(
+        self, bound_trace, monkeypatch
+    ):
+        monkeypatch.setenv("PIO_TRAIN_STEP_TIMELINE", "1")
+        self._train(iterations=4)
+        steps = sorted(
+            (
+                f
+                for f in dt.FRAGMENTS.fragments("trace1")
+                if f["name"].startswith("als.train_step[")
+            ),
+            key=lambda f: f["tags"]["iteration"],
+        )
+        assert [f["tags"]["iteration"] for f in steps] == [0, 1, 2, 3]
+        assert all(f["track"].startswith("train:") for f in steps)
+        assert all(f["duration_s"] > 0 for f in steps)
+        # the per-iteration track renders as its own Perfetto lane
+        tl = tlm.collect_trace("trace1", include_local=True)
+        assert len(tl.device_events()) >= 4
+
+    def test_untraced_train_emits_nothing(self, monkeypatch):
+        monkeypatch.setenv("PIO_TRAIN_STEP_TIMELINE", "1")
+        self._train(iterations=2)
+        assert not any(
+            f["name"].startswith("als.train_step")
+            for tid in dt.FRAGMENTS.trace_ids()
+            for f in dt.FRAGMENTS.fragments(tid)
+        )
+
+    def test_trace_alone_does_not_opt_in(self, bound_trace, monkeypatch):
+        """run_train binds the instance id as every run's trace id — a
+        bound trace WITHOUT the explicit env opt-in must not cost a
+        per-iteration host-device block (or emit fragments)."""
+        monkeypatch.delenv("PIO_TRAIN_STEP_TIMELINE", raising=False)
+        self._train(iterations=2)
+        assert not any(
+            f["name"].startswith("als.train_step")
+            for f in dt.FRAGMENTS.fragments("trace1")
+        )
+
+
+# ---------------------------------------------------------------------------
+# acceptance: an 8-virtual-device sharded wave with one slowed shard trips
+# the skew gauge and names the straggler on /shards.json
+
+
+class TestStragglerAcceptance:
+    @pytest.fixture()
+    def als_sharded(self):
+        from predictionio_tpu.data.bimap import BiMap
+        from predictionio_tpu.models.recommendation.engine import (
+            ALSAlgorithm,
+            ALSAlgorithmParams,
+            ALSModel,
+        )
+        from predictionio_tpu.obs.device import STRAGGLERS
+        from predictionio_tpu.ops.als import ALSParams, train_als
+
+        STRAGGLERS.clear()
+        rng = np.random.default_rng(7)
+        nu, ni = 40, 33
+        ui = rng.integers(0, nu, 1500).astype(np.int32)
+        ii = rng.integers(0, ni, 1500).astype(np.int32)
+        r = rng.uniform(1, 5, 1500).astype(np.float32)
+        st = train_als(
+            ui, ii, r, nu, ni,
+            ALSParams(rank=4, num_iterations=3, chunk_size=512),
+        )
+        algo = ALSAlgorithm(ALSAlgorithmParams(rank=4, shard_serving=True))
+        model = ALSModel(
+            np.asarray(st.user_factors), np.asarray(st.item_factors),
+            BiMap.from_keys(np.array([f"u{i}" for i in range(nu)])),
+            BiMap.from_keys(np.array([f"i{i}" for i in range(ni)])),
+        )
+        blob = algo.make_persistent_model(None, model)
+        yield algo, algo.load_persistent_model(None, blob)
+        STRAGGLERS.clear()
+
+    def test_slowed_shard_trips_skew_and_shards_json(self, als_sharded):
+        import jax
+
+        from predictionio_tpu.models.recommendation.engine import Query
+        from predictionio_tpu.obs.device import STRAGGLERS
+        from predictionio_tpu.obs.http import add_observability_routes
+        from predictionio_tpu.obs.metrics import REGISTRY
+        from predictionio_tpu.server.httpd import HTTPApp
+
+        algo, model = als_sharded
+        assert len(jax.devices()) == 8  # the conftest virtual mesh
+        straggler = f"{jax.devices()[0].platform}:3"
+        faults.install(
+            [{"seam": "shard.settle", "kind": "latency",
+              "latency_s": 0.5, "match": straggler}]
+        )
+        for wave in range(4):  # past the default patience of 3
+            algo.batch_predict(
+                model,
+                [(i, Query(user=f"u{i + wave}", num=5)) for i in range(6)],
+            )
+        skew = REGISTRY.get("pio_shard_skew_frac")
+        assert skew.labels("als.sharded_topk").value > 0.5  # tripped
+        board = STRAGGLERS.snapshot()["functions"]["als.sharded_topk"]
+        assert board["straggler"] == straggler
+        # ... and the scoreboard names the device over HTTP
+        app = add_observability_routes(HTTPApp("shardstest"))
+        server = _serve(app)
+        try:
+            status, body = _get(
+                f"http://127.0.0.1:{server.port}/shards.json"
+            )
+        finally:
+            server.shutdown()
+        assert status == 200
+        fn = body["stragglers"]["functions"]["als.sharded_topk"]
+        assert fn["straggler"] == straggler
+        assert fn["last_max_device"] == straggler
+        assert fn["devices"][straggler]["slowest"] >= 3
+        # per-device placement attribution rides in the same body
+        assert len(body["shards"]["functions"]["als.sharded_topk"]) == 8
+
+    def test_balanced_mesh_stays_quiet(self, als_sharded):
+        from predictionio_tpu.models.recommendation.engine import Query
+        from predictionio_tpu.obs.device import STRAGGLERS
+
+        algo, model = als_sharded
+        for wave in range(3):
+            algo.batch_predict(
+                model, [(i, Query(user=f"u{i}", num=5)) for i in range(4)]
+            )
+        fns = STRAGGLERS.snapshot()["functions"]
+        board = fns.get("als.sharded_topk")
+        assert board is None or board["straggler"] is None
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: client -> `pio deploy` (aio + MicroBatcher) -> storage daemon,
+# assembled into ONE tree; then the daemon is SIGKILLed and assembly
+# tolerates the dead source
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_storage_daemon(root, port):
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "predictionio_tpu.tools.cli",
+            "storageserver", "--ip", "127.0.0.1", "--port", str(port),
+            "--root", str(root),
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        env=env,
+    )
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return proc
+        except OSError:
+            if proc.poll() is not None:
+                raise RuntimeError("storage daemon died at boot")
+            time.sleep(0.1)
+    proc.kill()
+    raise TimeoutError("storage daemon never bound its port")
+
+
+def _post(url: str, payload: dict, headers: dict | None = None,
+          timeout: float = 60.0):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+class TestCrossProcessE2E:
+    """The acceptance e2e: one request served through a REAL `pio deploy`
+    subprocess backed by a REAL storage-daemon subprocess produces a single
+    assembled trace tree — client + serving + storage processes, device
+    stages riding as Perfetto events, and a seeded ``remote.send`` latency
+    visible on the serving lane's ``storage.remote`` span."""
+
+    LATENCY_S = 0.3
+
+    @pytest.fixture()
+    def stack(self, tmp_path):
+        import os
+        import subprocess
+        import sys
+
+        from predictionio_tpu.core.base import EngineContext
+        from predictionio_tpu.core.engine import resolve_engine_factory
+        from predictionio_tpu.core.workflow import run_train
+        from predictionio_tpu.data.datamap import DataMap
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage.config import (
+            StorageConfig,
+            reset_storage,
+        )
+        from predictionio_tpu.tools import commands as cmd
+
+        import predictionio_tpu.models  # noqa: F401  register factories
+
+        daemon_port = _free_port()
+        daemon = _spawn_storage_daemon(tmp_path / "root", daemon_port)
+        env_vars = {
+            "PIO_HOME": str(tmp_path / "home"),
+            "PIO_STORAGE_SOURCES_R_TYPE": "remote",
+            "PIO_STORAGE_SOURCES_R_URL": (
+                f"http://127.0.0.1:{daemon_port}"
+            ),
+            "PIO_STORAGE_SOURCES_R_TIMEOUT": "10.0",
+            "PIO_STORAGE_SOURCES_R_RETRIES": "2",
+            "PIO_STORAGE_SOURCES_R_BREAKER_THRESHOLD": "3",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "R",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "R",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "R",
+        }
+        rt = reset_storage(StorageConfig.from_env(env_vars))
+        app = cmd.app_new(rt, "dtrace").app
+        levents = rt.l_events()
+        for i in range(6):
+            levents.insert(
+                Event(event="$set", entity_type="user",
+                      entity_id=f"u{i}",
+                      properties=DataMap({"name": f"user {i}"})),
+                app.id,
+            )
+        for i in range(20):
+            levents.insert(
+                Event(event="$set", entity_type="item",
+                      entity_id=f"i{i}",
+                      properties=DataMap({"categories": ["c1"]})),
+                app.id,
+            )
+        for n in range(90):
+            levents.insert(
+                Event(
+                    event="view" if n % 3 else "buy",
+                    entity_type="user", entity_id=f"u{n % 6}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{(n * 5 + n // 6) % 20}",
+                    properties=DataMap({}),
+                ),
+                app.id,
+            )
+        engine = resolve_engine_factory("ecommerce")()
+        params = engine.params_from_json(
+            {
+                "datasource": {"params": {"appName": "dtrace"}},
+                "algorithms": [
+                    {
+                        "name": "ecomm",
+                        "params": {
+                            "appName": "dtrace",
+                            "rank": 4,
+                            "numIterations": 2,
+                        },
+                    }
+                ],
+            }
+        )
+        run_train(
+            engine, params,
+            ctx=EngineContext(storage=rt, mode="train"),
+            engine_factory="ecommerce", storage=rt,
+        )
+        # the serving process: a REAL `pio deploy` (aio + MicroBatcher)
+        # with a seeded latency at the remote.send seam for event reads
+        serve_port = _free_port()
+        plan = json.dumps(
+            [{"seam": "remote.send", "kind": "latency",
+              "latency_s": self.LATENCY_S, "match": "/events"}]
+        )
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            PIO_FAULT_PLAN=plan, **env_vars,
+        )
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "predictionio_tpu.tools.cli",
+                "deploy", "--engine", "ecommerce",
+                "--ip", "127.0.0.1", "--port", str(serve_port),
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            env=env,
+        )
+        base = f"http://127.0.0.1:{serve_port}"
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                status, _ = _get_raw(base + "/status.json", timeout=2)
+                if status == 200:
+                    break
+            except Exception:
+                pass
+            if proc.poll() is not None:
+                raise RuntimeError("deploy subprocess died at boot")
+            time.sleep(0.25)
+        else:
+            proc.kill()
+            raise TimeoutError("deploy subprocess never became ready")
+        try:
+            yield daemon, proc, base, daemon_port
+        finally:
+            proc.kill()
+            proc.wait(timeout=10)
+            if daemon.poll() is None:
+                daemon.kill()
+                daemon.wait(timeout=10)
+            reset_storage(
+                StorageConfig.from_env(
+                    {"PIO_HOME": str(tmp_path / "post_home")}
+                )
+            )
+
+    def test_assembled_tree_spans_three_processes(self, stack, tmp_path):
+        import os
+        import signal
+
+        daemon, proc, base, daemon_port = stack
+        daemon_base = f"http://127.0.0.1:{daemon_port}"
+
+        # ---- phase 1: one traced request through the whole stack --------
+        tid = "e2e" + dt.new_span_id()
+        client_sid = dt.new_span_id()
+        t0 = time.time()
+        status, _body, headers = _post(
+            base + "/queries.json", {"user": "u1", "num": 3},
+            headers={
+                dt.TRACE_ID_HEADER: tid,
+                dt.PARENT_SPAN_HEADER: client_sid,
+            },
+        )
+        dur = time.time() - t0
+        assert status == 200
+        assert headers[dt.TRACE_ID_HEADER] == tid  # echoed back
+        # the collector is also a participant: record the client root
+        dt.record_fragment(
+            "client.request", t0, dur, trace_id=tid, span_id=client_sid
+        )
+        tl = tlm.collect_trace(
+            tid, urls=[base, daemon_base], include_local=True
+        )
+        assert tl.source_errors == []
+        assert len(tl.processes) == 3
+        assert any(p.startswith("predictionserver:") for p in tl.processes)
+        assert any(p.startswith("storage-server:") for p in tl.processes)
+        # ONE tree rooted at the client, no orphans
+        (root,) = tl.roots
+        assert root.name == "client.request"
+        assert not any(n.orphan for n in tl.nodes.values())
+
+        def names(node, acc):
+            acc.add((node.process.split(":")[0], node.name))
+            for c in node.children:
+                names(c, acc)
+            return acc
+
+        reached = names(root, set())
+        server_spans = {n for p, n in reached if p == "predictionserver"}
+        daemon_spans = {n for p, n in reached if p == "storage-server"}
+        assert "http.predictionserver" in server_spans
+        assert "serve.microbatch" in server_spans
+        assert "http.storage-server" in daemon_spans
+        # device-stage events ride the same trace as device-track events
+        dev = tl.device_events()
+        assert dev and all(n.track.startswith("device:") for n in dev)
+        # the seeded remote.send latency is visible on the serving lane's
+        # storage.remote span (the storage track), not smeared anywhere
+        storage_nodes = [
+            n
+            for n in tl.nodes.values()
+            if n.name == "storage.remote"
+            and n.process.startswith("predictionserver:")
+        ]
+        assert storage_nodes
+        assert max(n.duration_s for n in storage_nodes) >= self.LATENCY_S
+        # renders: text names every process; Chrome trace loads in Perfetto
+        txt = tl.render_text()
+        assert "3 process(es)" in txt
+        ct = json.loads(json.dumps(tl.to_chrome_trace()))
+        procs = [
+            e for e in ct["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"
+        ]
+        assert len(procs) == 3
+        assert any(
+            e["ph"] == "X" and e["cat"] == "device"
+            for e in ct["traceEvents"]
+        )
+        assert ct["otherData"]["trace_id"] == tid
+
+        # ---- phase 2: SIGKILL the daemon; assembly tolerates the dead
+        # source and keeps the surviving processes' fragments -------------
+        os.kill(daemon.pid, signal.SIGKILL)
+        daemon.wait(timeout=10)
+        tid2 = "e2e" + dt.new_span_id()
+        sid2 = dt.new_span_id()
+        t1 = time.time()
+        status2, _b, h2 = _post(
+            base + "/queries.json", {"user": "u2", "num": 3},
+            headers={
+                dt.TRACE_ID_HEADER: tid2,
+                dt.PARENT_SPAN_HEADER: sid2,
+            },
+        )
+        assert status2 == 200  # degraded model-only answers keep flowing
+        dt.record_fragment(
+            "client.request", t1, time.time() - t1,
+            trace_id=tid2, span_id=sid2,
+        )
+        tl2 = tlm.collect_trace(
+            tid2, urls=[base, daemon_base], include_local=True
+        )
+        assert tl2.source_errors and daemon_base in tl2.source_errors[0]
+        assert any(
+            p.startswith("predictionserver:") for p in tl2.processes
+        )
