@@ -612,6 +612,11 @@ print("metrics " + render_json_line(REGISTRY, [
     "pio_microbatch_device_seconds",
     "pio_microbatch_batch_size",
 ]), file=sys.stderr, flush=True)
+# solo-path host-stage attribution (obs/hotpath.py): where the request's
+# wall time went, by named stage — the BENCH-side view of /hotpath.json
+import json as _json
+print("hotpath " + _json.dumps(app.hotpath.snapshot()),
+      file=sys.stderr, flush=True)
 server.shutdown()
 """
 
@@ -712,6 +717,7 @@ def serving_p50_concurrent(model, num_users, clients=32, per_client=40):
         # cherry-picking the best (single shared core)
         med = sorted(rounds, key=lambda r: r["p99_ms"])[len(rounds) // 2]
         hist: dict = {}
+        hotpath: dict = {}
         try:
             # communicate(input=...) writes the stop line AND closes stdin;
             # closing stdin first makes communicate() raise ValueError on
@@ -724,9 +730,17 @@ def serving_p50_concurrent(model, num_users, clients=32, per_client=40):
                     hist = json.loads(line[len("metrics "):])
                     log("# serving_histograms "
                         + json.dumps(hist, sort_keys=True))
+                elif line.startswith("hotpath "):
+                    hotpath = json.loads(line[len("hotpath "):])
+                    from predictionio_tpu.obs.hotpath import (
+                        render_hotpath_text,
+                    )
+
+                    for ln in render_hotpath_text(hotpath).splitlines():
+                        log("# serving_hotpath " + ln)
         except Exception:
             srv.kill()
-        return med["p50_ms"], med["p99_ms"], hist
+        return med["p50_ms"], med["p99_ms"], hist, hotpath
     finally:
         if srv.poll() is None:
             srv.kill()
@@ -1336,7 +1350,9 @@ def main() -> None:
     def sec_als_serving():
         model = build_als_model(C.state, num_users, num_items)
         p50_single = serving_p50_single(model, num_users)
-        p50_conc, p99_conc, hist = serving_p50_concurrent(model, num_users)
+        p50_conc, p99_conc, hist, hotpath = serving_p50_concurrent(
+            model, num_users
+        )
         metrics["serving_p50_ms"] = round(p50_single, 3)
         metrics["serving_p50_concurrent32_ms"] = round(p50_conc, 3)
         metrics["serving_p99_concurrent32_ms"] = round(p99_conc, 3)
@@ -1344,6 +1360,10 @@ def main() -> None:
             # decomposed serving latency: request p50/p95/p99 by
             # route/status + queue-wait vs device-time from the registry
             metrics["serving_histograms"] = hist
+        if hotpath:
+            # per-stage host attribution of the same run (/hotpath.json
+            # shape): the ROADMAP item 3 perf arc starts from these numbers
+            metrics["serving_hotpath"] = hotpath
         log(
             f"# serving_p50={p50_single:.3f}ms "
             f"serving_p50_concurrent32={p50_conc:.3f}ms "

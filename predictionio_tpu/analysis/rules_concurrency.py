@@ -140,7 +140,10 @@ def _ancestors_until(node: ast.AST, stop: ast.AST) -> Iterator[ast.AST]:
 #: self-attributes that look like synchronization primitives
 _LOCK_ATTR_RE = re.compile(r"^_?(lock|cond|condition|mutex|rlock)$|_lock$|_cond$")
 
-#: threading constructors whose result is a lock-like guard
+#: threading constructors whose result is a lock-like guard.  The metered
+#: wrappers (obs/contention.py) count too: adopting ContendedLock on a hot
+#: lock must not silently retire the unlocked-mutation check for the state
+#: it guards.
 _LOCK_CTORS = frozenset(
     (
         "threading.Lock",
@@ -148,6 +151,8 @@ _LOCK_CTORS = frozenset(
         "threading.Condition",
         "threading.Semaphore",
         "threading.BoundedSemaphore",
+        "predictionio_tpu.obs.contention.ContendedLock",
+        "predictionio_tpu.obs.contention.ContendedCondition",
     )
 )
 

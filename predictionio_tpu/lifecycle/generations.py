@@ -34,7 +34,6 @@ from __future__ import annotations
 import hashlib
 import json
 import logging
-import threading
 import time
 from dataclasses import asdict, dataclass
 from typing import Any
@@ -43,6 +42,7 @@ from predictionio_tpu.data.storage.base import (
     Models,
     _manifest_part_names,
 )
+from predictionio_tpu.obs.contention import ContendedLock
 from predictionio_tpu.resilience import faults
 
 log = logging.getLogger("predictionio_tpu.lifecycle")
@@ -186,7 +186,11 @@ class GenerationStore:
         self.engine_version = engine_version
         self.engine_variant = engine_variant
         self.max_history = max(max_history, 2)
-        self._lock = threading.RLock()
+        # manifest read-modify-write sections serialize here (reentrant:
+        # transitions call read/write helpers under the same lock); metered
+        # so a slow storage backend holding the manifest lock shows up as
+        # pio_lock_wait_seconds{lock="generation_store"} on the other paths
+        self._lock = ContendedLock("generation_store", reentrant=True)
 
     @property
     def engine_key(self) -> str:

@@ -1,0 +1,172 @@
+"""Lock-contention attribution for the process's hot locks.
+
+A degraded coalescing rate or a lengthened wave tail often traces back to a
+host lock: the MicroBatcher condition, the metrics registry, the quality
+monitor's prediction-log lock.  Until now that was a hunch reconstructed
+from span gaps (the PR 9 span-id finding class); these wrappers turn it
+into a gauge.
+
+:class:`ContendedLock` wraps a ``threading.Lock`` (or ``RLock`` with
+``reentrant=True``) and meters ONLY the contended path: an uncontended
+acquisition is one non-blocking ``acquire(False)`` attempt — no clock
+reads, no metric writes — so adopting the wrapper costs the hot path
+nothing when the lock is free.  When the fast path loses, the blocking
+acquisition is timed into ``pio_lock_wait_seconds{lock}`` and counted in
+``pio_lock_contended_total{lock}``.
+
+:class:`ContendedCondition` is a ``threading.Condition`` built over a
+:class:`ContendedLock`, so condition re-acquisition after ``wait()`` —
+where waiters pile up behind the notifier — is attributed too.
+
+Metric children resolve lazily on first contention (never at import), and
+a thread-local re-entrancy guard lets the metrics registry instrument its
+OWN lock: resolving the lock metrics walks the registry, which acquires
+the registry lock; a resolution already in flight on this thread skips the
+observation instead of deadlocking on itself.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+#: re-entrancy guard: True while THIS thread is resolving lock metrics
+#: through the registry (whose own lock may be a ContendedLock)
+_resolving = threading.local()
+
+
+class ContendedLock:
+    """A ``with``-able lock whose blocked acquisitions are metered.
+
+    ``reentrant=True`` wraps an ``RLock`` (a re-entrant acquisition by the
+    owning thread takes the uncontended fast path, as it should — the
+    thread never blocks).  ``registry`` defaults to the process registry,
+    resolved lazily so construction order never matters.
+    """
+
+    __slots__ = ("name", "_inner", "_registry", "_m_wait", "_m_contended")
+
+    def __init__(
+        self,
+        name: str,
+        registry=None,
+        reentrant: bool = False,
+    ):
+        self.name = name
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+        self._registry = registry
+        self._m_wait = None
+        self._m_contended = None
+
+    def prime(self) -> "ContendedLock":
+        """Resolve the metric children NOW, while the caller guarantees
+        nothing holds the lock.  Required for a registry instrumenting its
+        OWN lock: a lazy resolution inside a contended acquire would walk
+        the registry and re-acquire the very lock being reported on —
+        self-deadlock on a non-reentrant lock."""
+        self._metrics()
+        return self
+
+    def _metrics(self):
+        """(wait histogram, contended counter) children, or (None, None)
+        while a resolution through the registry is already in flight on
+        this thread (the registry's own lock instrumenting itself)."""
+        if self._m_wait is not None:
+            return self._m_wait, self._m_contended
+        if getattr(_resolving, "busy", False):
+            return None, None
+        _resolving.busy = True
+        try:
+            reg = self._registry
+            if reg is None:
+                # lazy, and ONLY on the default path: the process registry
+                # instruments its own lock with registry=self, and resolves
+                # while obs.metrics is still mid-import
+                from predictionio_tpu.obs.metrics import REGISTRY
+
+                reg = REGISTRY
+            m_wait = reg.histogram(
+                "pio_lock_wait_seconds",
+                "Time spent blocked acquiring an instrumented hot lock",
+                labelnames=("lock",),
+            ).labels(self.name)
+            # the counter resolves (and publishes) BEFORE the histogram:
+            # the early return above keys on _m_wait, so a concurrent
+            # caller observing it set must never see _m_contended None —
+            # acquire() would .inc() on None with the inner lock held
+            self._m_contended = reg.counter(
+                "pio_lock_contended_total",
+                "Acquisitions of an instrumented hot lock that had to block",
+                labelnames=("lock",),
+            ).labels(self.name)
+            self._m_wait = m_wait
+        finally:
+            _resolving.busy = False
+        return self._m_wait, self._m_contended
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        # uncontended fast path: one non-blocking attempt, zero telemetry —
+        # histogram mass appears ONLY when an acquisition genuinely blocked
+        if self._inner.acquire(False):
+            return True
+        if not blocking:
+            return False
+        t0 = time.perf_counter()
+        ok = self._inner.acquire(True, timeout)
+        wait_s = time.perf_counter() - t0
+        m_wait, m_contended = self._metrics()
+        if m_wait is not None:
+            m_contended.inc()
+            m_wait.observe(wait_s)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+
+    def __enter__(self) -> "ContendedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self._inner.release()
+
+
+class ContendedCondition:
+    """``threading.Condition`` over a :class:`ContendedLock`.
+
+    Drop-in for the stdlib Condition surface the servers use (``with``,
+    ``wait``, ``wait_for``, ``notify``, ``notify_all``); every blocked
+    acquisition — including the re-acquisition inside ``wait`` — lands in
+    the lock's wait histogram.
+    """
+
+    __slots__ = ("lock", "_cond")
+
+    def __init__(self, name: str, registry=None):
+        self.lock = ContendedLock(name, registry=registry)
+        self._cond = threading.Condition(self.lock)
+
+    def __enter__(self):
+        self._cond.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return self._cond.__exit__(exc_type, exc, tb)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        return self.lock.acquire(blocking, timeout)
+
+    def release(self) -> None:
+        self.lock.release()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._cond.wait(timeout)
+
+    def wait_for(self, predicate, timeout: float | None = None):
+        return self._cond.wait_for(predicate, timeout)
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()

@@ -37,6 +37,7 @@ import time
 from collections import OrderedDict
 from typing import Any, Mapping
 
+from predictionio_tpu.obs.contention import ContendedLock
 from predictionio_tpu.obs.logging import get_request_id, get_trace_id
 
 #: headers under which trace context travels (request and response)
@@ -167,7 +168,9 @@ class FragmentStore:
     def __init__(self, max_traces: int = 256, max_spans_per_trace: int = 512):
         self.max_traces = max_traces
         self.max_spans_per_trace = max_spans_per_trace
-        self._lock = threading.Lock()
+        # every finished traced root span collects here; metered so a
+        # /spans.json scrape stalling the serving path is attributable
+        self._lock = ContendedLock("fragment_store")
         self._traces: OrderedDict[str, list[dict[str, Any]]] = OrderedDict()
 
     def add(self, trace_id: str, fragment: dict[str, Any]) -> None:

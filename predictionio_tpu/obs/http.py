@@ -40,6 +40,7 @@ from __future__ import annotations
 import json
 from typing import Any, Callable, Mapping
 
+from predictionio_tpu.obs.capacity import capacity_snapshot
 from predictionio_tpu.obs.device import device_snapshot, shards_snapshot
 from predictionio_tpu.obs.disttrace import FRAGMENTS, set_process_name
 from predictionio_tpu.obs.flight import FlightRecorder, current_annotations
@@ -51,6 +52,7 @@ from predictionio_tpu.obs.profiler import (
     ProfilerUnsupported,
     sample_runtime_gauges,
 )
+from predictionio_tpu.obs.sampling import SAMPLER
 from predictionio_tpu.obs.slo import SLOTracker, run_readiness
 from predictionio_tpu.obs.tracing import recent_traces
 
@@ -68,6 +70,8 @@ _OBS_PATHS = frozenset(
         "/quality.json",
         "/efficiency.json",
         "/shards.json",
+        "/hotpath.json",
+        "/capacity.json",
         "/healthz",
         "/readyz",
         "/slo.json",
@@ -135,6 +139,7 @@ def add_observability_routes(
     flight: FlightRecorder | None = None,
     debug_routes: bool = True,
     quality: Any | None = None,
+    hotpath: Any | None = None,
 ):
     """The full observability surface: metrics + logs + flight + profiler +
     health.  Installs ``app.slo`` / ``app.flight`` / ``app.readiness`` so
@@ -153,6 +158,15 @@ def add_observability_routes(
     ``quality`` (a :class:`~predictionio_tpu.obs.quality.QualityMonitor`)
     installs ``app.quality`` and — on debug-route servers — serves its
     snapshot at ``GET /quality.json``, gated like the other debug routes.
+
+    ``hotpath`` (a :class:`~predictionio_tpu.obs.hotpath.HotPathTracker`)
+    installs ``app.hotpath``.  Debug-route servers serve the solo-path
+    stage-attribution table at ``GET /hotpath.json`` (when a tracker is
+    installed), ``GET /capacity.json`` (the headroom model joins whatever
+    of ``app.slo`` / ``app.admission`` / ``app.microbatcher`` exists), and
+    ``GET /debug/stacks.json`` (the continuous host stack sampler — the
+    first request arms it; stack contents describe the program, so the
+    surface is debug-gated like the flight recorder).
     """
     from predictionio_tpu.server.httpd import (
         Request,
@@ -173,6 +187,8 @@ def add_observability_routes(
     app.readiness = dict(readiness or {})
     if quality is not None:
         app.quality = quality
+    if hotpath is not None:
+        app.hotpath = hotpath
     ring = get_log_ring()
 
     original_route = app.route
@@ -294,6 +310,50 @@ def add_observability_routes(
     @route("GET", "/shards\\.json")
     def shards_json(req: Request) -> Response:
         return json_response(200, shards_snapshot(reg))
+
+    # -- solo-path host-stage attribution ------------------------------------
+    if hotpath is not None:
+
+        @route("GET", "/hotpath\\.json")
+        def hotpath_json(req: Request) -> Response:
+            return json_response(200, app.hotpath.snapshot())
+
+    # -- capacity / headroom model -------------------------------------------
+    # the autoscaling input: observed load vs the device + admission
+    # ceilings, joined with SLO burn (obs/capacity.py)
+    @route("GET", "/capacity\\.json")
+    def capacity_json(req: Request) -> Response:
+        return json_response(200, capacity_snapshot(app, reg))
+
+    # -- continuous host stack sampler ---------------------------------------
+    # always-available host profiling: the first request arms the process
+    # sampler; subsequent requests read the running aggregation.
+    # ``?reset=1`` clears the aggregation first (keeps sampling) so a
+    # bounded capture (`pio profile --stacks --seconds N`) reads a fresh
+    # N-second window instead of everything since the sampler was armed.
+    # Debug-gated like the flight recorder — stack contents describe the
+    # program.
+    @route("GET", "/debug/stacks\\.json")
+    def stacks_json(req: Request) -> Response:
+        SAMPLER.start()
+        if req.query.get("reset") in ("1", "true"):
+            SAMPLER.reset()
+        fmt = req.query.get("format", "json")
+        if fmt == "speedscope":
+            return json_response(200, SAMPLER.speedscope())
+        if fmt == "collapsed":
+            return Response(
+                200,
+                SAMPLER.collapsed(),
+                content_type="text/plain; charset=utf-8",
+            )
+        if fmt != "json":
+            return json_response(
+                400, {"message": "format must be json|collapsed|speedscope"}
+            )
+        body = SAMPLER.snapshot()
+        body["collapsed"] = SAMPLER.collapsed()
+        return json_response(200, body)
 
     # -- flight recorder -----------------------------------------------------
     @route("GET", "/debug/flight\\.json")

@@ -27,6 +27,8 @@ from bisect import bisect_left
 from collections import deque
 from typing import Any, Iterable, Mapping
 
+from predictionio_tpu.obs.contention import ContendedLock
+
 #: Fixed log-spaced bucket upper bounds in seconds: 10 µs .. 10 s, four per
 #: decade.  Shared by every latency histogram so merging is allocation-free.
 LATENCY_BUCKETS: tuple[float, ...] = tuple(
@@ -297,9 +299,15 @@ class MetricsRegistry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # every call-site family lookup (incl. one per finished span)
+        # funnels through this lock, so its blocked acquisitions are
+        # metered; prime() resolves the lock's own metric children while
+        # nothing can hold it yet — lazy resolution inside a contended
+        # acquire would re-enter this registry under its own lock
+        self._lock = ContendedLock("metrics_registry", registry=self)
         self._families: dict[str, MetricFamily] = {}
         self.history = MetricsHistory()
+        self._lock.prime()
 
     def _family(
         self,

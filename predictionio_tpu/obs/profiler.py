@@ -138,6 +138,18 @@ _cache_size_seen: "weakref.WeakKeyDictionary[MetricsRegistry, int]" = (
     weakref.WeakKeyDictionary()
 )
 
+#: the per-device ``memory_stats`` walk crosses into the backend per device
+#: — the one probe here whose cost scales with topology — so scrapes
+#: arriving within this window reuse the cached gauge values instead of
+#: re-walking (two Prometheus scrapers a second apart must not double the
+#: backend chatter)
+MEMSTATS_MIN_INTERVAL_S = 1.0
+
+#: monotonic time of the last memory_stats walk, per registry
+_memstats_last: "weakref.WeakKeyDictionary[MetricsRegistry, float]" = (
+    weakref.WeakKeyDictionary()
+)
+
 
 def sample_runtime_gauges(registry: MetricsRegistry | None = None) -> bool:
     """Refresh JAX runtime gauges: live device buffers (count + bytes),
@@ -152,6 +164,13 @@ def sample_runtime_gauges(registry: MetricsRegistry | None = None) -> bool:
     this process: a scrape of the admin/dashboard/event/storage daemons
     must not trigger a multi-second backend init (or contend for the TPU
     the serving process exclusively holds) just to report empty gauges.
+
+    The call self-meters into ``pio_runtime_sample_seconds`` (this runs on
+    EVERY scrape, so its cost must be a metric, not a guess), and the
+    per-device ``memory_stats`` walk — the only probe whose cost scales
+    with device count — is skipped when the previous walk was under
+    :data:`MEMSTATS_MIN_INTERVAL_S` ago; the gauges simply keep their
+    cached values between walks.
     """
     reg = registry or REGISTRY
     if "jax" not in sys.modules:
@@ -160,6 +179,7 @@ def sample_runtime_gauges(registry: MetricsRegistry | None = None) -> bool:
         import jax
     except Exception:
         return False
+    t_start = time.perf_counter()
     try:
         arrs = jax.live_arrays()
         reg.gauge(
@@ -170,18 +190,22 @@ def sample_runtime_gauges(registry: MetricsRegistry | None = None) -> bool:
         ).set(sum(getattr(a, "nbytes", 0) for a in arrs))
     except Exception:
         pass
-    try:
-        fam = reg.gauge(
-            "pio_jax_device_memory_bytes",
-            "Backend-reported bytes in use per device",
-            labelnames=("device",),
-        )
-        for d in jax.local_devices():
-            stats = getattr(d, "memory_stats", lambda: None)()
-            if stats and "bytes_in_use" in stats:
-                fam.labels(str(d.id)).set(stats["bytes_in_use"])
-    except Exception:
-        pass
+    now = time.monotonic()
+    last_walk = _memstats_last.get(reg)
+    if last_walk is None or now - last_walk >= MEMSTATS_MIN_INTERVAL_S:
+        _memstats_last[reg] = now
+        try:
+            fam = reg.gauge(
+                "pio_jax_device_memory_bytes",
+                "Backend-reported bytes in use per device",
+                labelnames=("device",),
+            )
+            for d in jax.local_devices():
+                stats = getattr(d, "memory_stats", lambda: None)()
+                if stats and "bytes_in_use" in stats:
+                    fam.labels(str(d.id)).set(stats["bytes_in_use"])
+        except Exception:
+            pass
     try:
         from jax._src import pjit as _pjit  # no public cache-size API yet
 
@@ -216,4 +240,9 @@ def sample_runtime_gauges(registry: MetricsRegistry | None = None) -> bool:
             fam.labels(direction).set(total)
     except Exception:
         pass
+    reg.histogram(
+        "pio_runtime_sample_seconds",
+        "Cost of one sample_runtime_gauges pass (runs on every /metrics "
+        "scrape)",
+    ).observe(time.perf_counter() - t_start)
     return True

@@ -43,6 +43,7 @@ from collections import deque
 from typing import Any, Mapping
 
 from predictionio_tpu.core.metric import OptionAverageMetric
+from predictionio_tpu.obs.contention import ContendedLock
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
 
 log = logging.getLogger("predictionio_tpu.quality")
@@ -514,13 +515,16 @@ class QualityMonitor:
         self.drift_window = drift_window
         self.drift_patience = drift_patience
         self.max_distributions = max_distributions
-        self._lock = threading.Lock()
+        reg = registry or REGISTRY
+        # the serving hot path (observe_prediction, per request) and the
+        # ingest path (observe_feedback, per event) contend here — metered
+        # so gauge-recompute stalls become pio_lock_wait_seconds mass
+        self._lock = ContendedLock("quality_monitor", registry=reg)
         self._ring: deque[dict[str, Any]] = deque()
         self._by_rid: dict[str, dict[str, Any]] = {}
         self._by_entity: dict[str, dict[str, Any]] = {}
         self._variants: dict[str, dict[str, Any]] = {}
         self._detectors: dict[str, DriftDetector] = {}
-        reg = registry or REGISTRY
         self._m_logged = reg.counter(
             "pio_quality_predictions_total",
             "Predictions logged for online quality monitoring, by variant",

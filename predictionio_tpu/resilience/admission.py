@@ -14,8 +14,7 @@ is exported as ``pio_inflight_requests`` and sheds as
 
 from __future__ import annotations
 
-import threading
-
+from predictionio_tpu.obs.contention import ContendedLock
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
 
 #: one shed counter family shared by every shedding site (admission cap,
@@ -41,9 +40,12 @@ class AdmissionController:
             raise ValueError("max_inflight must be >= 1")
         self.max_inflight = max_inflight
         self.retry_after_s = retry_after_s
-        self._lock = threading.Lock()
-        self._inflight = 0
         reg = registry or REGISTRY
+        # every admitted request acquires twice (acquire + release); under
+        # concurrency this is a front-end hot lock, so blocked
+        # acquisitions are metered (pio_lock_wait_seconds{lock="admission"})
+        self._lock = ContendedLock("admission", registry=reg)
+        self._inflight = 0
         self._m_inflight = reg.gauge(
             "pio_inflight_requests",
             "Requests currently admitted and executing",

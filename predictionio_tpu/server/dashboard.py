@@ -15,6 +15,7 @@ import os
 from urllib.parse import quote
 
 from predictionio_tpu.data.storage.config import StorageRuntime, get_storage
+from predictionio_tpu.obs.capacity import capacity_snapshot
 from predictionio_tpu.obs.device import device_snapshot
 from predictionio_tpu.obs.http import add_observability_routes
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
@@ -349,6 +350,72 @@ def _health_html(app: HTTPApp) -> str:
     )
 
 
+def _capacity_html(app: HTTPApp) -> str:
+    """Capacity panel: the headroom model (obs/capacity.py) over this
+    process's registry — max-sustainable QPS, which ceiling binds, and the
+    recommended replica count an autoscaler would act on."""
+    snap = capacity_snapshot(app, REGISTRY)
+    headroom = snap.get("headroom_frac")
+    inputs = snap.get("inputs", {})
+    input_rows = "".join(
+        f"<tr><td>{html.escape(str(k))}</td>"
+        f"<td>{html.escape(str(v))}</td></tr>"
+        for k, v in inputs.items()
+        if v is not None
+    )
+    ceiling_rows = "".join(
+        f"<tr><td>{html.escape(name)}"
+        f"{' (binding)' if name == snap.get('binding_ceiling') else ''}</td>"
+        f"<td>{qps:g} qps</td></tr>"
+        for name, qps in snap.get("ceilings_qps", {}).items()
+    )
+    caveats = "".join(
+        f"<li>{html.escape(c)}</li>" for c in snap.get("caveats", [])
+    )
+    return (
+        "<h2>Capacity</h2><p>headroom: <b>"
+        + (f"{headroom:.1%}" if headroom is not None else "unknown")
+        + "</b>, max sustainable: <b>"
+        + (
+            f"{snap['max_sustainable_qps']:g} qps"
+            if snap.get("max_sustainable_qps") is not None
+            else "unknown"
+        )
+        + f"</b>, recommended replicas: "
+        f"<b>{snap.get('recommended_replicas') or '?'}</b>, "
+        f"scale hint: <b>{html.escape(str(snap.get('scale_hint')))}</b></p>"
+        "<table border='1'><tr><th>ceiling</th><th>qps</th></tr>"
+        + ceiling_rows
+        + "</table><table border='1'><tr><th>input</th><th>value</th></tr>"
+        + input_rows
+        + "</table>"
+        + (f"<ul>{caveats}</ul>" if caveats else "")
+    )
+
+
+def _profiling_html(access_key: str | None = None) -> str:
+    """Profiling panel: the on-demand device profile and the continuous
+    host stack sampler, side by side — one answers "what is the device
+    doing", the other "where is the host spending its milliseconds", and a
+    slow request usually needs both."""
+    qs = f"?accessKey={quote(access_key)}" if access_key else ""
+    amp = "&" if access_key else "?"
+    return (
+        "<h2>Profiling</h2><table border='1'>"
+        "<tr><th>device (on-demand)</th><th>host (continuous)</th></tr>"
+        "<tr><td>jax.profiler capture: "
+        f"<code>POST /debug/profile{qs}{amp}seconds=N</code> "
+        f"(<a href='/debug/profile{qs}'>status</a>); view the trace dir "
+        "in tensorboard</td>"
+        f"<td><a href='/debug/stacks.json{qs}'>stack summary</a> · "
+        f"<a href='/debug/stacks.json{qs}{amp}format=speedscope'>"
+        "speedscope</a> · "
+        f"<a href='/debug/stacks.json{qs}{amp}format=collapsed'>"
+        "collapsed</a> (first click arms the sampler; see also "
+        "<code>pio profile --stacks</code>)</td></tr></table>"
+    )
+
+
 def create_dashboard_app(
     storage: StorageRuntime | None = None,
     access_key: str | None = None,
@@ -406,8 +473,10 @@ def create_dashboard_app(
             "<table border='1'><tr><th>id</th><th>evaluation</th>"
             f"<th>started</th><th>finished</th><th>result</th></tr>{rows}"
             f"</table>{_health_html(app)}"
+            f"{_capacity_html(app)}"
             f"{quality_html}"
             f"{_efficiency_html(REGISTRY)}"
+            f"{_profiling_html(access_key=access_key)}"
             f"{_traces_table_html(access_key=access_key)}"
             f"{_metrics_table_html(REGISTRY)}</body></html>",
         )

@@ -42,6 +42,7 @@ from collections import deque
 from typing import Any, Callable, Sequence
 
 from predictionio_tpu.obs import device as device_obs
+from predictionio_tpu.obs.contention import ContendedCondition
 from predictionio_tpu.obs.disttrace import (
     bind_parent_span,
     current_trace_context,
@@ -113,12 +114,21 @@ class MicroBatcher:
                 float | None, tuple,
             ]
         ] = deque()
-        self._cond = threading.Condition()
+        #: every submitter and the worker serialize on this condition: when
+        #: wave coalescing degrades under concurrency, this is the first
+        #: lock to suspect — so its blocked acquisitions are metered
+        #: (pio_lock_wait_seconds{lock="microbatch"}, obs/contention.py)
+        self._cond = ContendedCondition("microbatch", registry=registry)
         self._worker: threading.Thread | None = None
         self._in_wave = False
         self._closed = False
         #: wave-size histogram for the status page ({batch_size: count})
         self.wave_sizes: dict[int, int] = {}
+        #: rolling window of recent wave sizes feeding the coalescing-rate
+        #: gauge (items per wave) — the effect-size twin of the lock-wait
+        #: metrics: contention on the submit path shows up here as waves
+        #: shrinking toward 1
+        self._recent_waves: deque[int] = deque(maxlen=64)
         #: monotonically increasing wave number, exposed through per-item
         #: meta so downstream consumers (flight recorder, prediction log)
         #: can tell which dispatch wave served a request
@@ -167,6 +177,10 @@ class MicroBatcher:
         self._m_solo_retry = reg.counter(
             "pio_microbatch_solo_retry_total",
             "Failed waves retried item-by-item to isolate a poison query",
+        )
+        self._m_coalescing = reg.gauge(
+            "pio_microbatch_coalescing_rate",
+            "Queries coalesced per dispatch wave over a rolling window",
         )
 
     def wave_histogram(self) -> dict[int, int]:
@@ -396,18 +410,25 @@ class MicroBatcher:
                     meta["wave_size"] = len(items)
                     meta["wave_seq"] = wave_seq
                     meta["wave_request_ids"] = rids
-            # under the cond: the status page reads wave_sizes from
-            # other threads, and dict writes must not race its snapshot
-            with self._cond:
-                self.wave_sizes[len(items)] = (
-                    self.wave_sizes.get(len(items), 0) + 1
-                )
+            self._note_wave(len(items))
             self._post(loop, futures, results, None)
         except Exception as e:
             if len(live) == 1 or not self.solo_retry:
                 self._post(loop, futures, None, e)
             else:
                 self._solo_retry_pass(live, e, wave_seq)
+
+    def _note_wave(self, size: int) -> None:
+        """Record one dispatched wave's size — under the cond (the status
+        page reads ``wave_sizes`` from other threads, and dict writes must
+        not race its snapshot) — and refresh the rolling coalescing-rate
+        gauge."""
+        with self._cond:
+            self.wave_sizes[size] = self.wave_sizes.get(size, 0) + 1
+            self._recent_waves.append(size)
+            self._m_coalescing.set(
+                sum(self._recent_waves) / len(self._recent_waves)
+            )
 
     def _observe_timeline(
         self, timeline: "device_obs.WaveTimeline", device_s: float
@@ -488,8 +509,7 @@ class MicroBatcher:
                 meta["wave_size"] = 1
                 meta["wave_seq"] = wave_seq
                 meta["solo_retry"] = True
-            with self._cond:
-                self.wave_sizes[1] = self.wave_sizes.get(1, 0) + 1
+            self._note_wave(1)
             _post_one(fut, result=result)
             now = _deadline_now()
 

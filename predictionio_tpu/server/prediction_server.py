@@ -43,8 +43,14 @@ from predictionio_tpu.lifecycle.generations import (
     CorruptModelError,
     GenerationStore,
 )
+from predictionio_tpu.obs import device as device_obs
 from predictionio_tpu.obs.disttrace import note_wave_events
 from predictionio_tpu.obs.flight import annotate
+from predictionio_tpu.obs.hotpath import (
+    WAVE_STAGE_MAP,
+    HotPathTracker,
+    StageClock,
+)
 from predictionio_tpu.obs.http import add_observability_routes
 from predictionio_tpu.obs.logging import get_request_id
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
@@ -363,7 +369,11 @@ class DeployedEngine:
     def predict_bound(self, binding: Binding, query: Any) -> tuple[Any, Any]:
         if binding.role == "canary" and faults.ACTIVE is not None:
             faults.ACTIVE.check("canary.predict", binding.instance.id)
-        query = binding.serving.supplement(query)
+        # supplement is the host-side entity gather (recent events, seen
+        # filters): marked so the hot-path stage table and wave timelines
+        # attribute it instead of folding it into "dispatch"/"other"
+        with device_obs.wave_stage("host_gather"):
+            query = binding.serving.supplement(query)
         predictions = [
             a.predict(m, query)
             for a, m in zip(binding.algorithms, binding.models)
@@ -381,7 +391,8 @@ class DeployedEngine:
         if binding.role == "canary" and faults.ACTIVE is not None:
             faults.ACTIVE.check("canary.predict", binding.instance.id)
         serving = binding.serving
-        supplemented = [serving.supplement(q) for q in queries]
+        with device_obs.wave_stage("host_gather"):
+            supplemented = [serving.supplement(q) for q in queries]
         per_algo: list[list[Any]] = []
         for a, m in zip(binding.algorithms, binding.models):
             by_idx = dict(a.batch_predict(m, list(enumerate(supplemented))))
@@ -543,6 +554,10 @@ def create_prediction_server_app(
             return True
         return all(br.state != "open" for br in storage.breakers())
 
+    # solo-path host-stage attribution (obs/hotpath.py): every fully-served
+    # request decomposes into named host stages; /hotpath.json holds the
+    # p50/p99-per-stage table at ≥95 % wall-time coverage
+    hotpath = HotPathTracker(registry)
     add_observability_routes(
         app,
         registry,
@@ -554,6 +569,7 @@ def create_prediction_server_app(
             "storage_breakers": _storage_breakers_ok,
         },
         quality=quality,
+        hotpath=hotpath,
     )
     m_latency = registry.histogram(
         "pio_request_latency_seconds",
@@ -810,6 +826,7 @@ def create_prediction_server_app(
         @app.route("POST", "/queries\\.json")
         async def queries(req: Request) -> Response:
             t0 = time.perf_counter()
+            clock = StageClock()
             try:
                 payload = req.json()
                 if not isinstance(payload, dict):
@@ -817,15 +834,27 @@ def create_prediction_server_app(
             except Exception as e:
                 _observe("/queries.json", 400, t0)
                 return error_response(400, f"invalid query: {e}")
+            clock.lap("parse")
             # the worker fills meta with this query's queue-wait/device
             # split + wave mates; annotate() hands it to the flight recorder
             meta: dict[str, Any] = {}
             route_info: tuple[str, str] | None = None
             try:
                 with trace("serve.microbatch", record=False) as mb_span:
+                    clock.lap("route")
                     status, value, degraded, route_info = (
                         await batcher.submit(payload, meta)
                     )
+                    # decompose the await window: queued wait + the wave's
+                    # device-stage split, leftover = loop wakeup + future
+                    # resolution (the "block until ready" tail)
+                    parts = {"queue_wait": meta.get("queue_wait_s") or 0.0}
+                    for key, seconds in (
+                        meta.get("device_breakdown") or {}
+                    ).items():
+                        stage = WAVE_STAGE_MAP.get(key, key)
+                        parts[stage] = parts.get(stage, 0.0) + seconds
+                    clock.split(parts, remainder="block_until_ready")
                     # the wave's device-stage + per-shard events become
                     # device-track fragments of THIS request's trace,
                     # parented under the serve span (obs/disttrace.py)
@@ -894,6 +923,12 @@ def create_prediction_server_app(
                 # budget): correct-but-degraded, stamped so clients and
                 # probes can tell (metrics carry pio_degraded_total)
                 resp.headers["X-Pio-Degraded"] = ",".join(degraded)
+            # encode NOW (memoized — the front end reuses it) so the JSON
+            # serialization cost lands in the serialize stage, then close
+            # the attribution window
+            resp.encoded()
+            clock.lap("serialize")
+            hotpath.observe_clock(clock)
             return resp
 
     else:
@@ -901,6 +936,7 @@ def create_prediction_server_app(
         @app.route("POST", "/queries\\.json")
         def queries(req: Request) -> Response:
             t0 = time.perf_counter()
+            clock = StageClock()
 
             def _stamped(resp: Response, binding=None) -> Response:
                 # every answer — errors included — names the generation
@@ -919,12 +955,21 @@ def create_prediction_server_app(
             except Exception as e:
                 _observe("/queries.json", 400, t0)
                 return _stamped(error_response(400, f"invalid query: {e}"))
+            clock.lap("parse")
             binding = deployed.binding_for_entity(
                 deployed.payload_entity(payload)
             )
+            clock.lap("route")
             try:
                 with deployed.serving_slot(binding), degraded_scope() as degraded:
-                    query, prediction = deployed.predict_bound(binding, query)
+                    # the wave timeline collects the engine's stage marks
+                    # (supplement's host_gather, any device h2d/compute/d2h)
+                    # so the predict window splits into named stages; the
+                    # unattributed interior is "dispatch"
+                    with device_obs.wave_timeline() as timeline:
+                        query, prediction = deployed.predict_bound(
+                            binding, query
+                        )
             except DeadlineExceeded as e:
                 _observe("/queries.json", 504, t0)
                 return _stamped(
@@ -939,9 +984,19 @@ def create_prediction_server_app(
                 return _stamped(
                     error_response(500, f"{type(e).__name__}: {e}"), binding
                 )
+            clock.split(
+                {
+                    WAVE_STAGE_MAP.get(k, k): v
+                    for k, v in timeline.stages.items()
+                },
+                remainder="dispatch",
+            )
             resp = _finish_query(payload, query, prediction, t0, binding)
             if degraded:
                 resp.headers["X-Pio-Degraded"] = ",".join(degraded)
+            resp.encoded()
+            clock.lap("serialize")
+            hotpath.observe_clock(clock)
             return resp
 
     def _authorized(req: Request) -> bool:

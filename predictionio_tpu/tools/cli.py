@@ -951,6 +951,183 @@ def do_lifecycle(args) -> int:
     )
 
 
+def do_capacity(args) -> int:
+    """`pio capacity`: the capacity / headroom model.
+
+    With ``--url``, reads a running prediction server's ``/capacity.json``
+    (observed load vs the device and admission ceilings, joined with SLO
+    burn into max-sustainable-QPS / headroom / recommended replicas);
+    without it, computes the model over this process's registry.
+    ``--watch SECONDS`` mirrors `pio metrics --watch`.
+    """
+
+    def render_once() -> None:
+        from predictionio_tpu.obs.capacity import (
+            capacity_snapshot,
+            render_capacity_text,
+        )
+
+        if args.url:
+            snap = json.loads(
+                _fetch_url(
+                    args.url.rstrip("/") + "/capacity.json",
+                    getattr(args, "access_key", None),
+                )
+            )
+        else:
+            snap = capacity_snapshot(None)
+        print(
+            json.dumps(snap, indent=2)
+            if args.json
+            else render_capacity_text(snap)
+        )
+
+    return _run_watched(
+        "pio capacity", render_once, args.watch, args.watch_count
+    )
+
+
+def do_profile(args) -> int:
+    """`pio profile`: capture a profile of a running server (or this
+    process).
+
+    The default arms the on-demand ``jax.profiler`` capture on the server
+    (``POST /debug/profile`` — key-gated) and reports where the trace
+    landed.  ``--stacks`` skips the device profiler and captures HOST
+    stacks instead: the server's continuous sampler is armed (and its
+    aggregation reset to a fresh window) via
+    ``GET /debug/stacks.json?reset=1``, aggregates for ``--seconds``, and the
+    result prints as a summary + collapsed flamegraph text — or lands in
+    ``--speedscope OUT.json``, loadable at https://www.speedscope.app with
+    zero build steps.  A backend that answers 501 (jax profiler
+    unsupported — CPU wheels, missing plugin) automatically degrades to
+    the host-only stack capture instead of erroring: there is always SOME
+    profile.  Without ``--url`` the stack capture samples THIS process.
+    """
+    import threading
+    import urllib.error
+    import urllib.request
+
+    seconds = args.seconds
+    if seconds <= 0:
+        print("usage error: --seconds must be positive", file=sys.stderr)
+        return 2
+    pacer = threading.Event()
+
+    def _request(url: str, method: str = "GET") -> tuple[int, str]:
+        headers = {}
+        key = getattr(args, "access_key", None)
+        if key:
+            headers["Authorization"] = f"Bearer {key}"
+        req = urllib.request.Request(url, headers=headers, method=method)
+        try:
+            with urllib.request.urlopen(
+                req, timeout=max(seconds + 10.0, 15.0)
+            ) as r:
+                return r.status, r.read().decode("utf-8")
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode("utf-8", "replace")
+
+    def _write_speedscope(doc: dict) -> None:
+        Path(args.speedscope).write_text(json.dumps(doc))
+        print(
+            f"wrote speedscope profile to {args.speedscope} "
+            "(open at https://www.speedscope.app)"
+        )
+
+    def _remote_stacks() -> int:
+        base = args.url.rstrip("/")
+        # the first request arms the server's sampler AND resets its
+        # aggregation (the sampler may have been running for hours via the
+        # dashboard — the window must contain only the next --seconds);
+        # the second request, after the window, reads the fresh aggregation
+        status, body = _request(base + "/debug/stacks.json?reset=1")
+        if status != 200:
+            print(
+                f"stack capture failed: HTTP {status}: {body[:200]}",
+                file=sys.stderr,
+            )
+            return 1
+        pacer.wait(seconds)
+        status, body = _request(base + "/debug/stacks.json")
+        if status != 200:
+            print(
+                f"stack capture failed: HTTP {status}: {body[:200]}",
+                file=sys.stderr,
+            )
+            return 1
+        snap = json.loads(body)
+        collapsed = snap.pop("collapsed", "")
+        print(json.dumps(snap, indent=2))
+        if args.speedscope:
+            status, body = _request(
+                base + "/debug/stacks.json?format=speedscope"
+            )
+            if status != 200:
+                print(
+                    f"speedscope export failed: HTTP {status}",
+                    file=sys.stderr,
+                )
+                return 1
+            _write_speedscope(json.loads(body))
+        elif collapsed:
+            print(collapsed, end="")
+        return 0
+
+    def _local_stacks() -> int:
+        from predictionio_tpu.obs.sampling import StackSampler
+
+        sampler = StackSampler()
+        sampler.start()
+        pacer.wait(seconds)
+        sampler.stop()
+        print(json.dumps(sampler.snapshot(), indent=2))
+        if args.speedscope:
+            _write_speedscope(sampler.speedscope())
+        else:
+            print(sampler.collapsed(), end="")
+        return 0
+
+    try:
+        if not args.url:
+            return _local_stacks()
+        if args.stacks or args.speedscope:
+            # --speedscope IS a stack capture (the device profiler writes
+            # tensorboard traces, not speedscope JSON): asking for the
+            # file without --stacks must not silently produce nothing
+            return _remote_stacks()
+        base = args.url.rstrip("/")
+        status, body = _request(
+            f"{base}/debug/profile?seconds={seconds:g}", method="POST"
+        )
+        if status == 202:
+            started = json.loads(body)
+            print(
+                f"jax profiler capturing {seconds:g}s into "
+                f"{started.get('dir')} (server-side)"
+            )
+            pacer.wait(seconds + 0.5)
+            status, body = _request(base + "/debug/profile")
+            if status == 200:
+                print(json.dumps(json.loads(body), indent=2))
+            return 0
+        if status == 501:
+            # the verb still delivers: host-only stack capture
+            print(
+                "jax profiler unsupported on this backend; capturing host "
+                "stacks instead",
+                file=sys.stderr,
+            )
+            return _remote_stacks()
+        print(
+            f"profile failed: HTTP {status}: {body[:300]}", file=sys.stderr
+        )
+        return 1
+    except Exception as e:  # dead daemon: message + exit 1, no traceback
+        print(f"profile failed: {e}", file=sys.stderr)
+        return 1
+
+
 def do_check(args) -> int:
     """`pio check`: JAX-aware static analysis + DASE contract pre-flight.
 
@@ -1540,6 +1717,79 @@ def build_parser() -> argparse.ArgumentParser:
         help=argparse.SUPPRESS,  # bounded --watch iterations (tests)
     )
     ql.set_defaults(fn=do_quality)
+
+    cp = sub.add_parser(
+        "capacity",
+        description="Capacity / headroom model: observed load vs the "
+        "device and admission ceilings, joined with SLO burn into "
+        "max-sustainable-QPS, headroom fraction, and a recommended "
+        "replica count — from a running server's /capacity.json or this "
+        "process's registry.",
+    )
+    cp.add_argument(
+        "--url", help="read a running server (e.g. http://127.0.0.1:8000)"
+    )
+    cp.add_argument(
+        "--json", action="store_true",
+        help="raw /capacity.json instead of the text summary",
+    )
+    cp.add_argument(
+        "--access-key",
+        default=None,
+        help="access key for key-gated servers (sent as a Bearer header)",
+    )
+    cp.add_argument(
+        "--watch",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="re-render every SECONDS until interrupted",
+    )
+    cp.add_argument(
+        "--watch-count",
+        type=int,
+        default=None,
+        help=argparse.SUPPRESS,  # bounded --watch iterations (tests)
+    )
+    cp.set_defaults(fn=do_capacity)
+
+    pf = sub.add_parser(
+        "profile",
+        description="Profile a running server: arm the on-demand "
+        "jax.profiler capture (default; key-gated POST /debug/profile), "
+        "or capture host stacks via the continuous sampler (--stacks; "
+        "GET /debug/stacks.json).  A 501-unsupported backend degrades to "
+        "the host-only stack capture automatically.  Without --url, "
+        "samples this process's threads.",
+    )
+    pf.add_argument(
+        "--url", help="target server (e.g. http://127.0.0.1:8000)"
+    )
+    pf.add_argument(
+        "--seconds",
+        type=float,
+        default=5.0,
+        help="capture window (default 5)",
+    )
+    pf.add_argument(
+        "--stacks",
+        action="store_true",
+        help="capture host stacks (continuous sampler) instead of the "
+        "jax device profile",
+    )
+    pf.add_argument(
+        "--speedscope",
+        metavar="OUT.json",
+        default=None,
+        help="write the stack capture as speedscope JSON "
+        "(https://www.speedscope.app)",
+    )
+    pf.add_argument(
+        "--access-key",
+        default=None,
+        help="access key for key-gated servers (sent as a Bearer header)",
+    )
+    pf.set_defaults(fn=do_profile)
 
     lcp = sub.add_parser(
         "lifecycle",

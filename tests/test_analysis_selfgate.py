@@ -179,6 +179,73 @@ def test_disttrace_modules_lint_clean_with_zero_pragmas():
     assert baselined == []
 
 
+def test_hostprofile_modules_lint_clean_with_zero_pragmas():
+    """The host-profiling layer — sampling.py (a pass per period over
+    every thread), contention.py (wrapping the process's hottest locks),
+    hotpath.py (per-request stage attribution), capacity.py (the scrape-
+    time headroom join) — must be `pio check`-clean with NO pragma
+    suppressions and NO baseline entries — same bar as the rest of obs/."""
+    files = [
+        PACKAGE / "obs" / "sampling.py",
+        PACKAGE / "obs" / "contention.py",
+        PACKAGE / "obs" / "hotpath.py",
+        PACKAGE / "obs" / "capacity.py",
+    ]
+    report = analyze_paths(files, root=REPO_ROOT)
+    assert report.errors == []
+    assert report.findings == [], "\n".join(f.text() for f in report.findings)
+    assert report.pragma_suppressed == 0
+    names = {
+        "predictionio_tpu/obs/sampling.py",
+        "predictionio_tpu/obs/contention.py",
+        "predictionio_tpu/obs/hotpath.py",
+        "predictionio_tpu/obs/capacity.py",
+    }
+    baselined = [
+        e for e in Baseline.load(BASELINE).entries if e.file in names
+    ]
+    assert baselined == []
+
+
+def test_conc003_recognizes_contended_lock_wrappers():
+    """Adopting ContendedLock/ContendedCondition on a hot lock must NOT
+    silently retire the unlocked-mutation check for the state it guards:
+    the wrappers count as lock constructors for PIO-CONC003, and the real
+    adopters (MicroBatcher, admission, quality, generations, disttrace)
+    stay clean under the stricter rule."""
+    from predictionio_tpu.analysis.analyzer import analyze_source
+
+    src = (
+        "from predictionio_tpu.obs.contention import ContendedLock\n"
+        "\n"
+        "\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = ContendedLock('box')\n"
+        "        self.items = []\n"
+        "\n"
+        "    def add(self, x):\n"
+        "        with self._lock:\n"
+        "            self.items.append(x)\n"
+        "\n"
+        "    def sneaky(self, x):\n"
+        "        self.items.append(x)\n"
+    )
+    findings = analyze_source(src, "contended_box.py")
+    assert [(f.rule, f.line) for f in findings] == [("PIO-CONC003", 14)]
+
+    adopters = [
+        PACKAGE / "server" / "microbatch.py",
+        PACKAGE / "resilience" / "admission.py",
+        PACKAGE / "obs" / "quality.py",
+        PACKAGE / "obs" / "disttrace.py",
+        PACKAGE / "lifecycle" / "generations.py",
+    ]
+    report = analyze_paths(adopters, root=REPO_ROOT)
+    assert report.errors == []
+    assert report.findings == [], "\n".join(f.text() for f in report.findings)
+
+
 def test_trace_assemble_smoke():
     """Tier-1 smoke of the trace assembler's CI-gateable entry point:
     `pio trace --json` round-trips the recorded two-process fragment set in

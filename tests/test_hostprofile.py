@@ -1,0 +1,1011 @@
+"""Host-path profiling, lock-contention attribution, and the capacity model.
+
+Covers the host-side observability layer (ISSUE 10): the continuous stack
+sampler (obs/sampling.py), the ContendedLock/ContendedCondition wrappers
+(obs/contention.py), solo-path hot-path stage attribution (obs/hotpath.py),
+the capacity/headroom model (obs/capacity.py), the sample_runtime_gauges
+cost guard, the new HTTP surfaces and CLI verbs, and the acceptance e2e
+against a real deployed engine.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import types
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs.capacity import (
+    capacity_snapshot,
+    render_capacity_text,
+)
+from predictionio_tpu.obs.contention import ContendedCondition, ContendedLock
+from predictionio_tpu.obs.hotpath import (
+    HotPathTracker,
+    StageClock,
+    render_hotpath_text,
+)
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.sampling import (
+    SAMPLER,
+    StackSampler,
+    thread_role,
+)
+from predictionio_tpu.server.httpd import Request
+from predictionio_tpu.tools.cli import main as cli_main
+
+
+# -- lock-contention attribution ---------------------------------------------
+
+
+class TestContendedLock:
+    def test_uncontended_acquisitions_leave_zero_histogram_mass(self):
+        """A single thread acquiring/releasing must produce NO wait-time
+        mass — the fast path is one non-blocking attempt with no telemetry,
+        so adopting the wrapper costs a free lock nothing."""
+        reg = MetricsRegistry()
+        lock = ContendedLock("quiet", registry=reg)
+        for _ in range(200):
+            with lock:
+                pass
+        fam = reg.get("pio_lock_wait_seconds")
+        # metric children resolve lazily on first contention: with zero
+        # contention the family may not even exist
+        if fam is not None:
+            assert all(c.count == 0 for _, c in fam.series())
+        fam = reg.get("pio_lock_contended_total")
+        if fam is not None:
+            assert all(c.value == 0 for _, c in fam.series())
+
+    def test_sixteen_threads_contending_record_wait_mass(self):
+        """16 threads hammering a lock that is HELD records contended
+        acquisitions and wait-time histogram mass attributed to the lock's
+        name."""
+        reg = MetricsRegistry()
+        lock = ContendedLock("hot", registry=reg)
+        barrier = threading.Barrier(16)
+        per_thread = 30
+
+        def worker():
+            barrier.wait()
+            for _ in range(per_thread):
+                with lock:
+                    # hold long enough that the other 15 genuinely block
+                    t0 = time.perf_counter()
+                    while time.perf_counter() - t0 < 0.0005:
+                        pass
+
+        threads = [threading.Thread(target=worker) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wait = reg.get("pio_lock_wait_seconds").labels("hot")
+        contended = reg.get("pio_lock_contended_total").labels("hot")
+        assert contended.value > 0
+        counts, total, n = wait.snapshot()
+        assert n == contended.value
+        assert total > 0.0  # real blocked time, not just counted attempts
+
+    def test_reentrant_lock_never_counts_own_thread(self):
+        """A re-entrant re-acquisition by the owner takes the uncontended
+        fast path — the thread never blocks on itself."""
+        reg = MetricsRegistry()
+        lock = ContendedLock("re", registry=reg, reentrant=True)
+        with lock:
+            with lock:
+                pass
+        fam = reg.get("pio_lock_contended_total")
+        if fam is not None:
+            assert all(c.value == 0 for _, c in fam.series())
+
+    def test_condition_wait_notify_roundtrip(self):
+        """ContendedCondition is a drop-in for the stdlib Condition surface
+        the MicroBatcher uses: wait_for blocks until notified, and the
+        wait-side re-acquisition is attributable."""
+        reg = MetricsRegistry()
+        cond = ContendedCondition("cv", registry=reg)
+        state = {"ready": False, "seen": False}
+
+        def waiter():
+            with cond:
+                cond.wait_for(lambda: state["ready"], timeout=5.0)
+                state["seen"] = True
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        with cond:
+            state["ready"] = True
+            cond.notify_all()
+        t.join(timeout=5.0)
+        assert state["seen"] is True
+
+    def test_registry_can_instrument_its_own_lock(self):
+        """A MetricsRegistry's own lock is a ContendedLock pointing back at
+        the registry — 16 threads creating families concurrently must not
+        deadlock, and the registry reports on ITSELF."""
+        reg = MetricsRegistry()
+        barrier = threading.Barrier(16)
+
+        def worker(i: int):
+            barrier.wait()
+            for k in range(50):
+                reg.counter(f"c_{k % 7}", "d").inc()
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert not any(t.is_alive() for t in threads), "registry deadlocked"
+        # the registry's own lock resolved its children through itself
+        fam = reg.get("pio_lock_wait_seconds")
+        assert fam is not None  # primed at construction
+        assert ("metrics_registry",) in dict(fam.series())
+
+    def test_non_blocking_acquire_contract(self):
+        lock = ContendedLock("nb", registry=MetricsRegistry())
+        assert lock.acquire(blocking=False) is True
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(lock.acquire(blocking=False))
+        )
+        t.start()
+        t.join()
+        assert got == [False]
+        lock.release()
+
+
+# -- stack sampler -----------------------------------------------------------
+
+
+class TestStackSampler:
+    def test_thread_role_mapping(self):
+        assert thread_role("microbatch") == "microbatcher"
+        assert thread_role("pio-lifecycle") == "lifecycle-controller"
+        assert thread_role("predictionserver-aio") == "aio-loop"
+        assert thread_role("eventserver-http") == "http-serve"
+        assert thread_role("Thread-7 (process_request_thread)") == "http-serve"
+        assert thread_role("asyncio_0") == "executor-worker"
+        assert thread_role("ThreadPoolExecutor-0_3") == "executor-worker"
+        assert thread_role("MainThread") == "main"
+        assert thread_role("my-custom") == "my-custom"
+
+    def test_samples_and_labels_roles(self):
+        """The sampler sees a running thread and labels it by role; the
+        collapsed export carries role-rooted stacks with counts."""
+        stop = threading.Event()
+
+        def spin():
+            while not stop.is_set():
+                sum(range(50))
+
+        t = threading.Thread(target=spin, name="microbatch", daemon=True)
+        t.start()
+        s = StackSampler(hz=200, registry=MetricsRegistry())
+        s.start()
+        try:
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                snap = s.snapshot()
+                if snap["samples"] >= 10 and "microbatcher" in snap["threads"]:
+                    break
+                time.sleep(0.05)
+        finally:
+            s.stop()
+            stop.set()
+        snap = s.snapshot()
+        assert snap["samples"] >= 10
+        assert "microbatcher" in snap["threads"]
+        collapsed = s.collapsed()
+        assert collapsed  # non-empty
+        for line in collapsed.strip().splitlines():
+            stack, _, count = line.rpartition(" ")
+            assert int(count) > 0
+            assert ";" in stack  # role;frame;...
+        assert any(
+            line.startswith("microbatcher;")
+            for line in collapsed.splitlines()
+        )
+
+    def test_speedscope_export_shape(self):
+        s = StackSampler(hz=100, registry=MetricsRegistry())
+        s.start()
+        time.sleep(0.3)
+        s.stop()
+        doc = s.speedscope()
+        assert doc["$schema"] == (
+            "https://www.speedscope.app/file-format-schema.json"
+        )
+        assert doc["profiles"], "no profiles sampled"
+        frames = doc["shared"]["frames"]
+        for p in doc["profiles"]:
+            assert p["type"] == "sampled"
+            assert p["unit"] == "seconds"
+            assert len(p["samples"]) == len(p["weights"])
+            for row in p["samples"]:
+                for idx in row:
+                    assert 0 <= idx < len(frames)
+            assert p["endValue"] == pytest.approx(sum(p["weights"]), abs=1e-6)
+
+    def test_max_stacks_bound_drops_instead_of_growing(self):
+        s = StackSampler(hz=100, max_stacks=1, registry=MetricsRegistry())
+        # synthesize entries directly through the sampling pass
+        s.start()
+        stop = threading.Event()
+
+        def churn():
+            # distinct stacks: alternate call depth
+            def a():
+                time.sleep(0.001)
+
+            def b():
+                a()
+
+            while not stop.is_set():
+                a()
+                b()
+
+        t = threading.Thread(target=churn, name="churn", daemon=True)
+        t.start()
+        time.sleep(0.5)
+        s.stop()
+        stop.set()
+        snap = s.snapshot()
+        assert snap["distinct_stacks"] <= 1
+        assert snap["dropped_stacks"] > 0
+
+    def test_hz_clamping_and_env(self, monkeypatch):
+        monkeypatch.setenv("PIO_STACK_SAMPLER_HZ", "10000")
+        s = StackSampler(registry=MetricsRegistry())
+        s.start()
+        s.stop()
+        assert s.hz == 500.0  # MAX_HZ clamp
+        monkeypatch.setenv("PIO_STACK_SAMPLER_HZ", "not-a-number")
+        s2 = StackSampler(registry=MetricsRegistry())
+        s2.start()
+        s2.stop()
+        assert s2.hz == 100.0  # default on unparseable env
+
+    def test_reset_clears_counts_but_keeps_sampling(self):
+        s = StackSampler(hz=200, registry=MetricsRegistry())
+        s.start()
+        time.sleep(0.2)
+        assert s.snapshot()["samples"] > 0
+        s.reset()
+        snap = s.snapshot()
+        assert snap["samples"] <= 2  # freshly cleared (a pass may land)
+        time.sleep(0.2)
+        assert s.snapshot()["samples"] > 0  # still running
+        s.stop()
+
+    def test_overhead_under_two_percent_at_100hz(self):
+        """The tentpole bound: the sampler's self-metered overhead stays
+        under 2 % of one core at 100 Hz with realistic thread count."""
+        stop = threading.Event()
+
+        def spin():
+            while not stop.is_set():
+                sum(range(200))
+
+        threads = [
+            threading.Thread(target=spin, name=f"w{i}", daemon=True)
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        s = StackSampler(hz=100, registry=MetricsRegistry())
+        s.start()
+        time.sleep(0.5)
+        s.reset()  # drop the cold first passes (import/alloc warmup)
+        time.sleep(3.0)
+        snap = s.snapshot()
+        s.stop()
+        stop.set()
+        assert snap["samples"] > 0
+        assert snap["overhead_frac"] < 0.02, snap
+
+    def test_self_metered_histogram_lands_in_registry(self):
+        reg = MetricsRegistry()
+        s = StackSampler(hz=200, registry=reg)
+        s.start()
+        time.sleep(0.2)
+        s.stop()
+        fam = reg.get("pio_stack_sampler_seconds")
+        assert fam is not None
+        assert fam.labels().count > 0
+
+
+# -- hot-path stage attribution ----------------------------------------------
+
+
+class TestStageClock:
+    def test_lap_attributes_elapsed_time(self):
+        c = StageClock()
+        time.sleep(0.02)
+        c.lap("parse")
+        time.sleep(0.01)
+        c.lap("route")
+        assert c.stages["parse"] >= 0.015
+        assert c.stages["route"] >= 0.005
+        assert sum(c.stages.values()) <= c.total()
+
+    def test_add_advances_mark_no_double_count(self):
+        """Externally-measured time folded in with add() must not be
+        re-attributed by the next lap."""
+        c = StageClock()
+        time.sleep(0.02)
+        c.add("queue_wait", 0.015)  # externally measured inside the window
+        c.lap("block_until_ready")
+        total_attr = sum(c.stages.values())
+        assert total_attr <= c.total() + 1e-6
+        assert c.stages["queue_wait"] == pytest.approx(0.015)
+
+    def test_split_attributes_parts_then_remainder(self):
+        c = StageClock()
+        time.sleep(0.03)
+        c.split({"compute": 0.01, "h2d": 0.005}, remainder="dispatch")
+        assert c.stages["compute"] == pytest.approx(0.01)
+        assert c.stages["h2d"] == pytest.approx(0.005)
+        assert c.stages["dispatch"] >= 0.01  # the unattributed leftover
+        assert sum(c.stages.values()) <= c.total() + 1e-6
+
+    def test_split_clamps_overshoot_to_zero(self):
+        """Parts measured on another clock can exceed the window — the
+        remainder clamps at zero instead of going negative."""
+        c = StageClock()
+        c.split({"compute": 99.0}, remainder="dispatch")
+        assert "dispatch" not in c.stages
+
+
+class TestHotPathTracker:
+    def test_observe_and_snapshot_coverage(self):
+        reg = MetricsRegistry()
+        tr = HotPathTracker(reg)
+        for _ in range(10):
+            tr.observe(0.010, {"parse": 0.002, "dispatch": 0.007})
+        snap = tr.snapshot()
+        assert snap["requests"] == 10
+        assert snap["coverage_frac"] == pytest.approx(0.9, abs=0.01)
+        assert set(snap["stages"]) == {"parse", "dispatch"}
+        assert snap["stages"]["parse"]["share_frac"] == pytest.approx(
+            0.2, abs=0.01
+        )
+        # canonical ordering: parse renders before dispatch
+        assert list(snap["stages"]) == ["parse", "dispatch"]
+        text = render_hotpath_text(snap)
+        assert "parse" in text and "coverage" in text
+
+    def test_observe_clock_end_to_end(self):
+        reg = MetricsRegistry()
+        tr = HotPathTracker(reg)
+        c = StageClock()
+        time.sleep(0.01)
+        c.lap("parse")
+        time.sleep(0.01)
+        c.lap("serialize")
+        tr.observe_clock(c)
+        snap = tr.snapshot()
+        assert snap["coverage_frac"] > 0.9
+        assert reg.get("pio_hotpath_stage_seconds").labels("parse").count == 1
+
+    def test_attributed_never_exceeds_total(self):
+        tr = HotPathTracker(MetricsRegistry())
+        tr.observe(0.010, {"parse": 0.020})  # overshoot clamps
+        assert tr.snapshot()["coverage_frac"] <= 1.0
+
+
+# -- capacity model ----------------------------------------------------------
+
+
+def _seed_serving_metrics(
+    reg: MetricsRegistry, items: int = 100, busy_s: float = 0.5,
+    latency_s: float = 0.02, requests: int = 100,
+):
+    bs = reg.histogram("pio_microbatch_batch_size", "d")
+    bs.observe(float(items))  # sum drives the ceiling; one giant wave is fine
+    dev = reg.histogram("pio_microbatch_device_seconds", "d")
+    dev.observe(busy_s)
+    lat = reg.histogram("pio_request_latency_seconds", "d", labelnames=("route", "status"))
+    for _ in range(requests):
+        lat.labels("/queries.json", "200").observe(latency_s)
+
+
+class _FakeSLO:
+    def __init__(self, requests=200, window_s=600.0, uptime_s=600.0,
+                 error_burn=0.0, latency_burn=0.0, status="ok"):
+        self._snap = {
+            "requests": requests,
+            "window_s": window_s,
+            "uptime_s": uptime_s,
+            "error_burn_rate": error_burn,
+            "latency_burn_rate": latency_burn,
+            "status": status,
+        }
+
+    def snapshot(self):
+        return dict(self._snap)
+
+
+class TestCapacityModel:
+    def _app(self, reg, max_inflight=32, qps=50.0):
+        from predictionio_tpu.resilience.admission import AdmissionController
+
+        app = types.SimpleNamespace()
+        app.slo = _FakeSLO(requests=int(qps * 600))
+        app.admission = AdmissionController(max_inflight, registry=reg)
+        app.microbatcher = types.SimpleNamespace(max_queue=1024)
+        return app
+
+    def test_ceiling_math(self):
+        reg = MetricsRegistry()
+        _seed_serving_metrics(reg, items=100, busy_s=0.5, latency_s=0.02)
+        app = self._app(reg, max_inflight=32)
+        snap = capacity_snapshot(app, reg)
+        # device: 100 items / 0.5 busy s = 200 qps
+        assert snap["ceilings_qps"]["device"] == pytest.approx(200.0)
+        # admission: 32 in-flight / 0.02 s = 1600 qps
+        assert snap["ceilings_qps"]["admission"] == pytest.approx(1600.0)
+        assert snap["binding_ceiling"] == "device"
+        assert snap["max_sustainable_qps"] == pytest.approx(200.0)
+        # observed 50 qps against a 200 qps ceiling: 75 % headroom
+        assert snap["headroom_frac"] == pytest.approx(0.75, abs=0.01)
+        # replicas sized for 70 % of 200 qps = 140 qps per replica
+        assert snap["recommended_replicas"] == 1
+        assert snap["scale_hint"] in ("hold_or_down", "hold")
+
+    def test_halving_inflight_cap_moves_headroom_down_not_up(self):
+        """The acceptance direction check at unit level: a smaller
+        admission cap can only lower (never raise) the estimate."""
+        reg = MetricsRegistry()
+        # make admission the binding ceiling: slow requests, modest cap
+        _seed_serving_metrics(reg, items=1000, busy_s=0.5, latency_s=0.1)
+        app = self._app(reg, max_inflight=8)
+        before = capacity_snapshot(app, reg)
+        assert before["binding_ceiling"] == "admission"
+        app.admission.max_inflight = 4
+        after = capacity_snapshot(app, reg)
+        assert after["ceilings_qps"]["admission"] == pytest.approx(
+            before["ceilings_qps"]["admission"] / 2
+        )
+        assert after["max_sustainable_qps"] < before["max_sustainable_qps"]
+        assert after["headroom_frac"] < before["headroom_frac"]
+
+    def test_burning_slo_zeroes_headroom_and_recommends_scale(self):
+        reg = MetricsRegistry()
+        _seed_serving_metrics(reg)
+        app = self._app(reg, qps=50.0)
+        app.slo = _FakeSLO(requests=int(50 * 600), error_burn=2.5,
+                           status="degraded")
+        snap = capacity_snapshot(app, reg)
+        assert snap["headroom_frac"] <= 0.0
+        assert snap["scale_hint"] == "up"
+        calm = capacity_snapshot(self._app(reg, qps=50.0), reg)
+        assert snap["recommended_replicas"] == calm["recommended_replicas"] + 1
+
+    def test_no_data_yields_caveats_not_invented_numbers(self):
+        reg = MetricsRegistry()
+        snap = capacity_snapshot(None, reg)
+        assert snap["max_sustainable_qps"] is None
+        assert snap["headroom_frac"] is None
+        assert snap["recommended_replicas"] is None
+        assert snap["scale_hint"] == "unknown"
+        assert any("device ceiling" in c for c in snap["caveats"])
+        text = render_capacity_text(snap)
+        assert "n/a" in text and "caveat" in text
+
+    def test_recommended_replicas_scales_with_load(self):
+        reg = MetricsRegistry()
+        _seed_serving_metrics(reg, items=100, busy_s=0.5)  # 200 qps ceiling
+        app = self._app(reg, qps=500.0)  # 2.5x over the ceiling
+        snap = capacity_snapshot(app, reg)
+        # 500 / (0.7 * 200) = 3.57 -> 4 replicas
+        assert snap["recommended_replicas"] == 4
+        assert snap["headroom_frac"] == -1.0  # clamped
+        assert snap["scale_hint"] == "up"
+
+
+# -- sample_runtime_gauges cost guard ----------------------------------------
+
+
+class TestRuntimeGaugeCostGuard:
+    def test_memstats_walk_cached_between_close_scrapes(self, monkeypatch):
+        """Regression (satellite): two scrapes <1 s apart must walk
+        per-device memory_stats ONCE; the second scrape reuses cached
+        gauges.  An aged cache entry re-walks."""
+        import jax
+
+        from predictionio_tpu.obs import profiler as profiler_mod
+
+        calls = {"n": 0}
+        real = jax.local_devices
+
+        def counting(*a, **k):
+            calls["n"] += 1
+            return real(*a, **k)
+
+        monkeypatch.setattr(jax, "local_devices", counting)
+        reg = MetricsRegistry()
+        assert profiler_mod.sample_runtime_gauges(reg) is True
+        assert profiler_mod.sample_runtime_gauges(reg) is True
+        assert calls["n"] == 1, "second scrape re-walked memory_stats"
+        # age the cache entry: the walk resumes
+        profiler_mod._memstats_last[reg] = 0.0
+        assert profiler_mod.sample_runtime_gauges(reg) is True
+        assert calls["n"] == 2
+
+    def test_scrape_cost_is_self_metered(self):
+        import jax  # noqa: F401 — gauge sampling requires jax in sys.modules
+
+        from predictionio_tpu.obs import profiler as profiler_mod
+
+        reg = MetricsRegistry()
+        assert profiler_mod.sample_runtime_gauges(reg) is True
+        fam = reg.get("pio_runtime_sample_seconds")
+        assert fam is not None
+        assert fam.labels().count == 1
+        profiler_mod.sample_runtime_gauges(reg)
+        assert fam.labels().count == 2
+
+
+# -- HTTP surfaces -----------------------------------------------------------
+
+
+def _bare_obs_app(access_key=None, hotpath=None, registry=None, name="srv"):
+    from predictionio_tpu.obs.http import add_observability_routes
+    from predictionio_tpu.server.httpd import HTTPApp
+
+    app = HTTPApp(name)
+    add_observability_routes(
+        app,
+        registry or MetricsRegistry(),
+        access_key=access_key,
+        hotpath=hotpath,
+    )
+    return app
+
+
+class TestHTTPSurfaces:
+    def test_hotpath_json_served_when_tracker_installed(self):
+        reg = MetricsRegistry()
+        tr = HotPathTracker(reg)
+        tr.observe(0.01, {"parse": 0.002, "dispatch": 0.008})
+        app = _bare_obs_app(hotpath=tr, registry=reg)
+        r = app.handle(Request("GET", "/hotpath.json", {}, {}))
+        assert r.status == 200
+        body = json.loads(r.encoded()[0])
+        assert body["requests"] == 1
+        assert "parse" in body["stages"]
+
+    def test_hotpath_json_absent_without_tracker(self):
+        app = _bare_obs_app()
+        r = app.handle(Request("GET", "/hotpath.json", {}, {}))
+        assert r.status == 404
+
+    def test_capacity_json_shape(self):
+        reg = MetricsRegistry()
+        _seed_serving_metrics(reg)
+        app = _bare_obs_app(registry=reg)
+        r = app.handle(Request("GET", "/capacity.json", {}, {}))
+        assert r.status == 200
+        body = json.loads(r.encoded()[0])
+        assert "ceilings_qps" in body and "headroom_frac" in body
+        assert body["ceilings_qps"]["device"] > 0
+
+    def test_stacks_json_arms_sampler_and_exports(self):
+        app = _bare_obs_app()
+        try:
+            r = app.handle(Request("GET", "/debug/stacks.json", {}, {}))
+            assert r.status == 200
+            assert SAMPLER.running
+            time.sleep(0.15)
+            r = app.handle(Request("GET", "/debug/stacks.json", {}, {}))
+            body = json.loads(r.encoded()[0])
+            assert body["samples"] > 0
+            assert "collapsed" in body
+            r = app.handle(
+                Request(
+                    "GET", "/debug/stacks.json", {"format": "speedscope"}, {}
+                )
+            )
+            doc = json.loads(r.encoded()[0])
+            assert doc["profiles"]
+            r = app.handle(
+                Request(
+                    "GET", "/debug/stacks.json", {"format": "collapsed"}, {}
+                )
+            )
+            assert r.status == 200
+            assert "text/plain" in r.content_type
+            r = app.handle(
+                Request("GET", "/debug/stacks.json", {"format": "bogus"}, {})
+            )
+            assert r.status == 400
+        finally:
+            SAMPLER.stop()
+
+    def test_new_routes_are_key_gated(self):
+        reg = MetricsRegistry()
+        tr = HotPathTracker(reg)
+        app = _bare_obs_app(access_key="sekret", hotpath=tr, registry=reg)
+        for path in ("/hotpath.json", "/capacity.json", "/debug/stacks.json"):
+            r = app.handle(Request("GET", path, {}, {}))
+            assert r.status == 401, path
+            r = app.handle(
+                Request(
+                    "GET", path, {}, {"Authorization": "Bearer sekret"}
+                )
+            )
+            assert r.status == 200, path
+        SAMPLER.stop()
+
+    def test_dashboard_renders_capacity_and_profiling_panels(self):
+        from predictionio_tpu.server.dashboard import (
+            _capacity_html,
+            _profiling_html,
+        )
+
+        app = _bare_obs_app()
+        html_body = _capacity_html(app)
+        assert "Capacity" in html_body and "headroom" in html_body
+        prof = _profiling_html(access_key="k&x")
+        assert "/debug/stacks.json" in prof
+        assert "speedscope" in prof
+        # gated-link bug class (PR 4/PR 9): no link carries two '?'
+        import re
+
+        for link in re.findall(r"href='([^']+)'", prof):
+            assert link.count("?") <= 1, link
+        # the key is carried and escaped on the links
+        assert "accessKey=k%26x" in prof
+
+
+# -- CLI verbs ---------------------------------------------------------------
+
+
+class TestCLIVerbs:
+    def test_capacity_local_renders(self, capsys):
+        assert cli_main(["capacity"]) == 0
+        out = capsys.readouterr().out
+        assert "max sustainable" in out
+
+    def test_capacity_local_json(self, capsys):
+        assert cli_main(["capacity", "--json"]) == 0
+        body = json.loads(capsys.readouterr().out)
+        assert "headroom_frac" in body
+
+    def test_capacity_dead_url_exits_1(self, capsys):
+        assert cli_main(["capacity", "--url", "http://127.0.0.1:9"]) == 1
+
+    def test_profile_local_stacks_with_speedscope(self, tmp_path, capsys):
+        out = tmp_path / "prof.json"
+        assert (
+            cli_main(
+                ["profile", "--seconds", "0.3", "--speedscope", str(out)]
+            )
+            == 0
+        )
+        doc = json.loads(out.read_text())
+        assert doc["profiles"]
+        printed = capsys.readouterr().out
+        assert "speedscope" in printed
+
+    def test_profile_rejects_nonpositive_seconds(self, capsys):
+        assert cli_main(["profile", "--seconds", "0"]) == 2
+
+
+# -- acceptance e2e ----------------------------------------------------------
+
+
+def _bench_style_deployed():
+    """A real DeployedEngine over the ALS recommendation template, no
+    storage daemon — the bench serving topology."""
+    from bench import build_als_model
+    from predictionio_tpu.core.base import FirstServing
+    from predictionio_tpu.models.recommendation.engine import ALSAlgorithm
+    from predictionio_tpu.server.prediction_server import DeployedEngine
+
+    rng = np.random.default_rng(7)
+    U = rng.standard_normal((50, 8)).astype(np.float32)
+    V = rng.standard_normal((120, 8)).astype(np.float32)
+
+    class _State:
+        user_factors = U
+        item_factors = V
+
+    model = build_als_model(_State(), 50, 120)
+    deployed = DeployedEngine.__new__(DeployedEngine)
+    deployed._lock = threading.RLock()
+    deployed.instance = types.SimpleNamespace(id="hostprof-e2e")
+    deployed.storage = None
+    deployed.algorithms = [ALSAlgorithm()]
+    deployed.models = [model]
+    deployed.serving = FirstServing()
+    return deployed
+
+
+def _post_query(base: str, user: str, timeout: float = 15.0) -> int:
+    req = urllib.request.Request(
+        base + "/queries.json",
+        data=json.dumps({"user": user, "num": 3}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status
+
+
+def _get_json(base: str, path: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+class TestAcceptanceE2E:
+    @pytest.fixture(scope="class")
+    def solo_server(self):
+        """Threaded (non-batched) front end: the SOLO serving path."""
+        from predictionio_tpu.server.httpd import AppServer
+        from predictionio_tpu.server.prediction_server import (
+            create_prediction_server_app,
+        )
+
+        reg = MetricsRegistry()
+        app = create_prediction_server_app(
+            _bench_style_deployed(), use_microbatch=False, registry=reg
+        )
+        server = AppServer(app, "127.0.0.1", 0).start_background()
+        server.registry = reg
+        yield server
+        server.shutdown()
+
+    @pytest.fixture(scope="class")
+    def batched_server(self):
+        """aio + MicroBatcher front end with an admission cap — the
+        topology the capacity model reads."""
+        from predictionio_tpu.server.aio import AsyncAppServer
+        from predictionio_tpu.server.prediction_server import (
+            create_prediction_server_app,
+        )
+
+        reg = MetricsRegistry()
+        app = create_prediction_server_app(
+            _bench_style_deployed(),
+            use_microbatch=True,
+            registry=reg,
+            max_inflight=64,
+        )
+        server = AsyncAppServer(app, "127.0.0.1", 0).start_background()
+        server.registry = reg
+        yield server
+        SAMPLER.stop()
+        server.shutdown()
+
+    def test_hotpath_attributes_95_percent_of_solo_wall_time(
+        self, solo_server
+    ):
+        """Acceptance: against a real deployed engine, /hotpath.json
+        attributes >=95 % of solo-request wall time to named stages."""
+        base = f"http://127.0.0.1:{solo_server.port}"
+        for i in range(40):
+            assert _post_query(base, str(i % 50)) == 200
+        snap = _get_json(base, "/hotpath.json")
+        assert snap["requests"] >= 40
+        assert snap["coverage_frac"] >= 0.95, snap
+        # the solo path decomposes into the documented taxonomy
+        assert {"parse", "route", "serialize"} <= set(snap["stages"])
+        assert "dispatch" in snap["stages"] or "compute" in snap["stages"]
+        # every stage row carries the quantile table
+        for row in snap["stages"].values():
+            assert row["p99_s"] >= row["p50_s"] >= 0
+
+    def test_sampler_under_concurrent_load_with_bounded_overhead(
+        self, batched_server
+    ):
+        """Acceptance: the stack sampler runs >=5 s under 32-way concurrent
+        load with measured overhead <2 % and produces a non-empty
+        speedscope export containing the MicroBatcher thread.
+
+        The 32 clients run in a CHILD process (as production load would):
+        the sampler meters the SERVING process, and an in-process load
+        generator would make it profile the test harness instead."""
+        import subprocess
+        import sys as _sys
+
+        base = f"http://127.0.0.1:{batched_server.port}"
+        # arm the sampler through the debug route (first request arms)
+        snap0 = _get_json(base, "/debug/stacks.json")
+        assert snap0["hz"] == 100.0
+
+        client_script = (
+            "import sys, json, threading, time, urllib.request\n"
+            "base, clients, seconds = (\n"
+            "    sys.argv[1], int(sys.argv[2]), float(sys.argv[3]))\n"
+            "stop = time.time() + seconds\n"
+            "count = [0] * clients\n"
+            "def run(i):\n"
+            "    n = 0\n"
+            "    while time.time() < stop:\n"
+            "        body = json.dumps(\n"
+            "            {'user': str((i * 31 + n) % 50), 'num': 3}\n"
+            "        ).encode()\n"
+            "        req = urllib.request.Request(\n"
+            "            base + '/queries.json', data=body,\n"
+            "            headers={'Content-Type': 'application/json'})\n"
+            "        with urllib.request.urlopen(req, timeout=30) as r:\n"
+            "            r.read()\n"
+            "        n += 1\n"
+            "    count[i] = n\n"
+            "ts = [threading.Thread(target=run, args=(i,))\n"
+            "      for i in range(clients)]\n"
+            "for t in ts: t.start()\n"
+            "for t in ts: t.join()\n"
+            "print(sum(count))\n"
+        )
+        t0 = time.time()
+        out = subprocess.run(
+            [_sys.executable, "-c", client_script, base, "32", "5.3"],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        elapsed = time.time() - t0
+        assert out.returncode == 0, out.stderr[-1000:]
+        served = int(out.stdout.strip())
+        assert elapsed >= 5.0
+        assert served > 32  # real sustained load, not one round
+
+        snap = _get_json(base, "/debug/stacks.json")
+        assert snap["duration_s"] >= 5.0
+        assert snap["samples"] > 50
+        assert snap["overhead_frac"] < 0.02, snap
+        # the flamegraph reads as the serving architecture
+        assert "microbatcher" in snap["threads"], snap["threads"]
+        doc = _get_json(base, "/debug/stacks.json?format=speedscope")
+        names = [p["name"] for p in doc["profiles"]]
+        assert "microbatcher" in names, names
+        assert all(doc["profiles"][i]["samples"] for i in range(len(names)))
+
+    def test_capacity_headroom_moves_down_when_cap_halved(
+        self, batched_server
+    ):
+        """Acceptance: /capacity.json's headroom estimate moves in the
+        correct direction when the admission in-flight cap is halved."""
+        base = f"http://127.0.0.1:{batched_server.port}"
+        # ensure observed load + latency exist (the sampler test may have
+        # run first and already seeded them; this makes the test order-free)
+        for i in range(30):
+            _post_query(base, str(i % 50))
+        before = _get_json(base, "/capacity.json")
+        assert before["max_sustainable_qps"] is not None
+        assert before["inputs"]["max_inflight"] == 64
+
+        app = batched_server.app
+        app.admission.max_inflight //= 2  # 32
+        mid = _get_json(base, "/capacity.json")
+        assert mid["inputs"]["max_inflight"] == 32
+        # between the two scrapes no new traffic landed: the mean latency
+        # input is identical, so the admission ceiling exactly halves
+        assert mid["ceilings_qps"]["admission"] == pytest.approx(
+            before["ceilings_qps"]["admission"] / 2, rel=0.2
+        )
+        # tiny positive drift is possible while admission does NOT bind:
+        # observed qps decays as the SLO window's uptime grows between
+        # scrapes — the cap change itself can only push headroom DOWN
+        assert mid["headroom_frac"] <= before["headroom_frac"] + 0.01
+
+        # squeeze until admission BINDS: headroom must strictly drop
+        app.admission.max_inflight = 1
+        after = _get_json(base, "/capacity.json")
+        assert after["binding_ceiling"] == "admission"
+        assert after["max_sustainable_qps"] < before["max_sustainable_qps"]
+        assert after["headroom_frac"] < before["headroom_frac"]
+        app.admission.max_inflight = 64  # restore for other tests
+
+    def test_pio_capacity_url_renders_with_exit_0(
+        self, batched_server, capsys
+    ):
+        """Acceptance: `pio capacity --url` renders the model, exit 0."""
+        base = f"http://127.0.0.1:{batched_server.port}"
+        assert cli_main(["capacity", "--url", base]) == 0
+        out = capsys.readouterr().out
+        assert "max sustainable" in out and "headroom" in out
+
+    def test_pio_profile_stacks_against_live_server(
+        self, batched_server, tmp_path, capsys
+    ):
+        base = f"http://127.0.0.1:{batched_server.port}"
+        out = tmp_path / "live.speedscope.json"
+        assert (
+            cli_main(
+                [
+                    "profile",
+                    "--url", base,
+                    "--stacks",
+                    "--seconds", "0.5",
+                    "--speedscope", str(out),
+                ]
+            )
+            == 0
+        )
+        doc = json.loads(out.read_text())
+        assert doc["profiles"]
+
+    def test_pio_profile_501_falls_back_to_host_stacks(
+        self, monkeypatch, tmp_path, capsys
+    ):
+        """Satellite: a backend whose jax profiler answers 501 still yields
+        a host-only stack capture instead of an error."""
+        from predictionio_tpu.obs import http as obs_http
+        from predictionio_tpu.obs.profiler import ProfilerUnsupported
+        from predictionio_tpu.server.httpd import AppServer
+
+        class _Unsupported:
+            def start(self, *a, **k):
+                raise ProfilerUnsupported("no backend support")
+
+            def status(self):
+                return {"running": False}
+
+        monkeypatch.setattr(obs_http, "PROFILER", _Unsupported())
+        # profiler arming requires SOME key; gate the app with one
+        app = _bare_obs_app(access_key="k")
+        server = AppServer(app, "127.0.0.1", 0).start_background()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            # the plain verb attempts the device profiler, gets the 501,
+            # announces the degrade, and delivers the host capture anyway
+            rc = cli_main(
+                [
+                    "profile",
+                    "--url", base,
+                    "--seconds", "0.4",
+                    "--access-key", "k",
+                ]
+            )
+            captured = capsys.readouterr()
+            assert rc == 0, captured.err
+            assert "host" in captured.err  # announced the degrade
+            assert '"samples"' in captured.out  # the host capture printed
+            # --speedscope IS a stack capture: it implies --stacks and
+            # must write the file even though the device profiler is 501
+            out = tmp_path / "fallback.json"
+            rc = cli_main(
+                [
+                    "profile",
+                    "--url", base,
+                    "--seconds", "0.4",
+                    "--access-key", "k",
+                    "--speedscope", str(out),
+                ]
+            )
+            captured = capsys.readouterr()
+            assert rc == 0, captured.err
+            doc = json.loads(out.read_text())
+            assert doc["profiles"]  # non-empty host capture
+        finally:
+            SAMPLER.stop()
+            server.shutdown()
+
+    def test_microbatcher_coalescing_rate_gauge(self, batched_server):
+        """Satellite: the coalescing-rate gauge (items per wave over a
+        rolling window) is exported and consistent with the wave
+        histogram."""
+        base = f"http://127.0.0.1:{batched_server.port}"
+        with ThreadPoolExecutor(16) as ex:
+            list(
+                ex.map(
+                    lambda i: _post_query(base, str(i % 50)), range(48)
+                )
+            )
+        reg = batched_server.registry
+        gauge = reg.get("pio_microbatch_coalescing_rate").labels()
+        assert gauge.value >= 1.0
+        waves = batched_server.app.microbatcher.wave_histogram()
+        assert sum(k * v for k, v in waves.items()) >= 48

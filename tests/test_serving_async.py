@@ -465,6 +465,12 @@ class TestMicrobatchedQueries:
         assert batch_size.sum == 48  # every query in some wave
         assert n_waves == sum(waves.values())
         assert 48 / n_waves > 1.0  # coalescing rate under load
+        # the same invariant as a live gauge: items per wave over the
+        # rolling window (the effect-size twin of the lock-wait metrics —
+        # submit-path contention shows up here as the rate sinking to 1)
+        coalescing = reg.get("pio_microbatch_coalescing_rate").labels()
+        assert coalescing.value > 1.0
+        assert coalescing.value == pytest.approx(48 / n_waves, rel=0.25)
         assert reg.get("pio_microbatch_queue_wait_seconds").labels().count == 48
         assert (
             reg.get("pio_request_latency_seconds")
