@@ -621,6 +621,131 @@ server.shutdown()
 """
 
 
+def bench_fleet_section(model, num_users, n_replicas: int, requests: int = 300):
+    """`python bench.py --fleet N`: router-overhead section.
+
+    N replica serving subprocesses (the same fresh-interpreter _SERVER_SCRIPT
+    the concurrent section uses, pinned to cpu) behind an in-process fleet
+    router; measures sequential p50/p99 direct-to-one-replica vs through the
+    router (same keep-alive client loop), plus the retry-elsewhere rate —
+    the router's whole value is affinity + failover at near-zero latency
+    cost, and ``fleet_router_overhead_ms`` is the regression gate on that
+    claim (BENCH_GATE_METRICS)."""
+    import http.client
+    import subprocess
+    import tempfile
+
+    from predictionio_tpu.fleet.membership import FleetState
+    from predictionio_tpu.fleet.router import create_router_app
+    from predictionio_tpu.obs.metrics import MetricsRegistry
+    from predictionio_tpu.server.httpd import AppServer
+
+    with tempfile.NamedTemporaryFile(suffix=".npz", delete=False) as f:
+        np.savez(
+            f,
+            U=np.asarray(model.user_factors, np.float32),
+            V=np.asarray(model.item_factors, np.float32),
+        )
+        blob_path = f.name
+    procs = []
+    ports = []
+    router = None
+    fleet = None
+    try:
+        for _ in range(n_replicas):
+            srv = subprocess.Popen(
+                [sys.executable, "-c", _SERVER_SCRIPT, blob_path],
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            procs.append(srv)
+        for srv in procs:
+            line = srv.stdout.readline()
+            if not line.strip():
+                srv.kill()
+                _, err = srv.communicate(timeout=10)
+                raise RuntimeError(f"fleet replica failed to start: {err[-800:]}")
+            ports.append(int(line))
+        reg = MetricsRegistry()
+        fleet = FleetState(
+            [f"http://127.0.0.1:{p}" for p in ports], registry=reg
+        )
+        fleet.probe_once()
+        router = AppServer(
+            create_router_app(fleet, registry=reg), "127.0.0.1", 0
+        ).start_background()
+
+        def measure(port: int, n: int) -> list[float]:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            lats = []
+            for q in range(n):
+                body = b'{"user": "%d", "num": 10}' % (q % num_users)
+                t0 = time.perf_counter()
+                conn.request(
+                    "POST", "/queries.json", body=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                data = resp.read()
+                lats.append((time.perf_counter() - t0) * 1000)
+                assert resp.status == 200, (resp.status, data[:200])
+            conn.close()
+            return sorted(lats)
+
+        measure(ports[0], 20)  # warm the direct path (jit + keep-alive)
+        measure(router.port, 20)  # warm the router path + all replicas
+        direct = measure(ports[0], requests)
+        routed = measure(router.port, requests)
+        retries = 0.0
+        forwards = 0.0
+        fam = reg.get("pio_router_retry_elsewhere_total")
+        if fam is not None:
+            retries = sum(c.value for _, c in fam.series())
+        fam = reg.get("pio_router_forwards_total")
+        if fam is not None:
+            forwards = sum(c.value for _, c in fam.series())
+        out = {
+            "fleet_replicas": n_replicas,
+            "fleet_direct_p50_ms": round(direct[len(direct) // 2], 3),
+            "fleet_direct_p99_ms": round(direct[int(len(direct) * 0.99)], 3),
+            "fleet_router_p50_ms": round(routed[len(routed) // 2], 3),
+            "fleet_router_p99_ms": round(routed[int(len(routed) * 0.99)], 3),
+            "fleet_router_overhead_ms": round(
+                routed[len(routed) // 2] - direct[len(direct) // 2], 3
+            ),
+            "fleet_retry_elsewhere_rate": round(
+                retries / forwards if forwards else 0.0, 6
+            ),
+        }
+        log(
+            f"# fleet replicas={n_replicas} "
+            f"direct p50={out['fleet_direct_p50_ms']:.2f}ms "
+            f"router p50={out['fleet_router_p50_ms']:.2f}ms "
+            f"p99={out['fleet_router_p99_ms']:.2f}ms "
+            f"overhead={out['fleet_router_overhead_ms']:.2f}ms "
+            f"retry_elsewhere={out['fleet_retry_elsewhere_rate']:.4f}"
+        )
+        return out
+    finally:
+        if router is not None:
+            router.shutdown()
+        if fleet is not None:
+            fleet.stop()
+        for srv in procs:
+            try:
+                if srv.poll() is None:
+                    srv.communicate(input="\n", timeout=10)
+            except Exception:
+                srv.kill()
+        try:
+            os.unlink(blob_path)
+        except OSError:
+            pass
+
+
 def serving_p50_concurrent(model, num_users, clients=32, per_client=40):
     """p50/p99 across 32 concurrent keep-alive clients hitting a real
     asyncio server + micro-batched /queries.json route.  Server AND load
@@ -1378,6 +1503,17 @@ def main() -> None:
     timeline_out = None
     if "--timeline" in sys.argv:
         timeline_out = sys.argv[sys.argv.index("--timeline") + 1]
+    # --fleet N: router + N replica subprocesses on this host (the
+    # router-overhead gate; replicas pin to cpu — this section measures
+    # the CPU-tier proxy hop, not device serving)
+    fleet_replicas = 0
+    if "--fleet" in sys.argv:
+        fleet_replicas = int(sys.argv[sys.argv.index("--fleet") + 1])
+
+    def sec_fleet():
+        metrics.update(
+            bench_fleet_section(C.state, num_users, fleet_replicas)
+        )
 
     def sec_sharded():
         res = bench_sharded_section(
@@ -1422,6 +1558,12 @@ def main() -> None:
             log("# SECTION als_serving SKIPPED: no trained ALS state")
     if shard_devices > 1:
         run_section("sharded", sec_sharded)
+    if fleet_replicas > 0:
+        if hasattr(C, "state"):
+            run_section("fleet", sec_fleet)
+        else:
+            failed.append("fleet")
+            log("# SECTION fleet SKIPPED: no trained ALS state")
 
     from predictionio_tpu.obs.device import BENCH_SCHEMA_VERSION
 

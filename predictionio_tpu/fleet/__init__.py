@@ -1,0 +1,42 @@
+"""Horizontal fleet layer: router + replica membership + autoscaler.
+
+The capacity model (obs/capacity.py) emits ``recommended_replicas`` and
+nothing consumed it; this package is the consumer.  Three pieces:
+
+- :mod:`~predictionio_tpu.fleet.membership` — the :class:`FleetState`
+  replica registry: health probing off each replica's ``/readyz``,
+  per-replica circuit breakers, ``/capacity.json`` scrapes, and the
+  consistent-hash (rendezvous over the HBEventsUtil md5 hash) entity
+  affinity the router routes by;
+- :mod:`~predictionio_tpu.fleet.router` — a thin CPU-tier HTTP front end
+  proxying ``/queries.json`` to N prediction-server replicas with
+  deadline-bounded retry-on-another-replica, serving ``/fleet.json`` and
+  the fleet-aggregated ``/capacity.json``;
+- :mod:`~predictionio_tpu.fleet.autoscaler` — the controller loop that
+  closes the capacity loop: scrape → aggregate → hysteresis/cooldown →
+  spawn or drain replica processes through the ``pio deploy`` machinery.
+
+See docs/fleet.md.
+"""
+
+from predictionio_tpu.fleet.autoscaler import (
+    Autoscaler,
+    AutoscalerPolicy,
+    LocalProcessSpawner,
+)
+from predictionio_tpu.fleet.membership import (
+    FleetState,
+    Replica,
+    fleet_capacity,
+)
+from predictionio_tpu.fleet.router import create_router_app
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalerPolicy",
+    "FleetState",
+    "LocalProcessSpawner",
+    "Replica",
+    "create_router_app",
+    "fleet_capacity",
+]

@@ -789,9 +789,11 @@ def als_plan_roofline(plan: Mapping[str, Any]) -> dict[str, float] | None:
 #: BENCH json schema: v2 introduced the roofline/utilization fields and the
 #: compare gate; v3 adds the ``--devices N`` sharded section (flat
 #: ``sharded_*`` metrics + the ``sharded_devices`` config echo the gate
-#: refuses to cross-compare).  ``pio bench --compare`` refuses version-less
+#: refuses to cross-compare); v4 adds the ``--fleet N`` router section
+#: (``fleet_*`` metrics + the ``fleet_replicas`` config echo, same
+#: cross-compare refusal).  ``pio bench --compare`` refuses version-less
 #: or older files.
-BENCH_SCHEMA_VERSION = 3
+BENCH_SCHEMA_VERSION = 4
 
 #: regression-gateable BENCH metrics and which direction is better.  Only
 #: keys present in BOTH files are compared; everything else (configuration
@@ -823,6 +825,10 @@ BENCH_GATE_METRICS: dict[str, str] = {
     "sharded_train_s": "lower",
     "sharded_serving_p50_ms": "lower",
     "sharded_serving_p99_ms": "lower",
+    # fleet section (bench --fleet N): the router hop must stay cheap
+    "fleet_router_p50_ms": "lower",
+    "fleet_router_p99_ms": "lower",
+    "fleet_router_overhead_ms": "lower",
 }
 
 
@@ -874,6 +880,17 @@ def compare_bench(
             f"sharded sections differ: current sharded_devices={cur_dev!r} "
             f"vs previous {prev_dev!r} — re-run bench with the same "
             "--devices to compare"
+        )
+        return 2, report
+    # fleet-section config: router latency over 2 replicas vs 8 is not the
+    # same measurement — refuse mismatched --fleet runs like --devices
+    cur_fleet = current.get("fleet_replicas")
+    prev_fleet = previous.get("fleet_replicas")
+    if cur_fleet != prev_fleet:
+        report["error"] = (
+            f"fleet sections differ: current fleet_replicas={cur_fleet!r} "
+            f"vs previous {prev_fleet!r} — re-run bench with the same "
+            "--fleet to compare"
         )
         return 2, report
     for key in sorted(BENCH_GATE_METRICS):

@@ -72,6 +72,7 @@ _OBS_PATHS = frozenset(
         "/shards.json",
         "/hotpath.json",
         "/capacity.json",
+        "/fleet.json",
         "/healthz",
         "/readyz",
         "/slo.json",

@@ -393,6 +393,83 @@ def _capacity_html(app: HTTPApp) -> str:
     )
 
 
+def _fleet_html(fleet_url: str, access_key: str | None = None) -> str:
+    """Fleet panel: the router's /fleet.json membership registry — who the
+    replicas are, which are routable, and what each last said about its
+    capacity.  A dead router costs one bounded fetch and renders as a
+    one-line notice (the dashboard must not die with the fleet)."""
+    import urllib.request
+
+    headers = {}
+    if access_key:
+        headers["Authorization"] = f"Bearer {access_key}"
+    try:
+        req = urllib.request.Request(
+            fleet_url.rstrip("/") + "/fleet.json", headers=headers
+        )
+        with urllib.request.urlopen(req, timeout=3.0) as r:
+            body = json.loads(r.read().decode("utf-8"))
+    except Exception as e:
+        return (
+            "<h2>Fleet</h2><p>router at "
+            f"<code>{html.escape(fleet_url)}</code> unreachable: "
+            f"{html.escape(str(e))}</p>"
+        )
+    rows = []
+    for rep in body.get("replicas", []):
+        state = "ok"
+        if rep.get("draining"):
+            state = "draining"
+        elif not rep.get("healthy"):
+            state = "EJECTED"
+        elif rep.get("breaker") == "open":
+            state = "BREAKER-OPEN"
+        cap = rep.get("capacity") or {}
+        headroom = cap.get("headroom_frac")
+        rows.append(
+            f"<tr><td>{html.escape(str(rep.get('replica')))}</td>"
+            f"<td>{state}</td>"
+            f"<td>{html.escape(str(rep.get('breaker')))}</td>"
+            f"<td>{rep.get('inflight', 0)}</td>"
+            f"<td>{_esc_num(cap.get('max_sustainable_qps'))}</td>"
+            "<td>"
+            + (
+                f"{headroom:.1%}"
+                if isinstance(headroom, (int, float))
+                else "n/a"
+            )
+            + "</td></tr>"
+        )
+    auto = body.get("autoscaler") or {}
+    auto_line = ""
+    if auto:
+        pol = auto.get("policy", {})
+        auto_line = (
+            "<p>autoscaler: "
+            f"[{pol.get('min_replicas')}..{pol.get('max_replicas')}] "
+            + (
+                f"pinned at {auto['target_override']}"
+                if auto.get("target_override") is not None
+                else "capacity-driven"
+            )
+            + "</p>"
+        )
+    return (
+        f"<h2>Fleet</h2><p>{body.get('total', 0)} replicas, "
+        f"<b>{body.get('routable', 0)}</b> routable "
+        f"(router: <code>{html.escape(fleet_url)}</code>)</p>"
+        "<table border='1'><tr><th>replica</th><th>state</th><th>breaker</th>"
+        "<th>inflight</th><th>max qps</th><th>headroom</th></tr>"
+        + "".join(rows)
+        + "</table>"
+        + auto_line
+    )
+
+
+def _esc_num(v) -> str:
+    return f"{v:g}" if isinstance(v, (int, float)) else "n/a"
+
+
 def _profiling_html(access_key: str | None = None) -> str:
     """Profiling panel: the on-demand device profile and the continuous
     host stack sampler, side by side — one answers "what is the device
@@ -421,6 +498,7 @@ def create_dashboard_app(
     access_key: str | None = None,
     quality: QualityMonitor | None = None,
     trace_sources: list[str] | None = None,
+    fleet_url: str | None = None,
 ) -> HTTPApp:
     """``access_key`` gates every route (Dashboard.scala:47 mixes in
     KeyAuthentication); TLS comes from the AppServer layer below.
@@ -429,7 +507,11 @@ def create_dashboard_app(
     URLs) names the other daemons' ``/spans.json`` endpoints the
     ``/trace/<id>`` waterfall assembles across — unset, the waterfall shows
     this process's fragments only (still useful for a `pio deploy` whose
-    embedded servers share one store)."""
+    embedded servers share one store).
+
+    ``fleet_url`` (default: ``PIO_FLEET_URL``) names a fleet router whose
+    ``/fleet.json`` renders as the Fleet panel — replica membership,
+    ejections, and per-replica capacity at a glance."""
     storage = storage or get_storage()
     app = HTTPApp("dashboard", access_key=access_key)
     quality = quality or default_quality()
@@ -439,6 +521,8 @@ def create_dashboard_app(
             for u in os.environ.get("PIO_TRACE_SOURCES", "").split(",")
             if u.strip()
         ]
+    if fleet_url is None:
+        fleet_url = os.environ.get("PIO_FLEET_URL") or None
 
     def _metadata_ready() -> bool:
         storage.evaluation_instances().get_completed()
@@ -474,7 +558,12 @@ def create_dashboard_app(
             f"<th>started</th><th>finished</th><th>result</th></tr>{rows}"
             f"</table>{_health_html(app)}"
             f"{_capacity_html(app)}"
-            f"{quality_html}"
+            + (
+                _fleet_html(fleet_url, access_key=access_key)
+                if fleet_url
+                else ""
+            )
+            + f"{quality_html}"
             f"{_efficiency_html(REGISTRY)}"
             f"{_profiling_html(access_key=access_key)}"
             f"{_traces_table_html(access_key=access_key)}"

@@ -199,6 +199,14 @@ class MicroBatcher:
         """True once close() began — the readiness signal for /readyz."""
         return self._closed
 
+    @property
+    def busy(self) -> bool:
+        """True while queries are queued or a wave is mid-dispatch — the
+        queue-side half of the fleet drain check (the generation-refcount
+        half lives on DeployedEngine.inflight_snapshot)."""
+        with self._cond:
+            return bool(self._pending) or self._in_wave
+
     async def submit(self, item: Any, meta: dict | None = None) -> Any:
         """Queue ``item`` for the next wave.  ``meta``, when given, is
         filled by the worker with this item's queue_wait_s / device_s /

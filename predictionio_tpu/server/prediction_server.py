@@ -273,6 +273,17 @@ class DeployedEngine:
                     self._inflight[iid] = n
                 cond.notify_all()
 
+    def inflight_snapshot(self) -> dict[str, int]:
+        """Per-generation in-flight request counts — the drain surface the
+        fleet autoscaler polls (via /status.json) before SIGTERMing a
+        quiesced replica: zero refcounts means no request would be
+        dropped."""
+        cond = self._drain_cond
+        if cond is None:
+            return {}
+        with cond:
+            return {k: v for k, v in self._inflight.items() if v > 0}
+
     def wait_drained(self, instance_id: str, timeout: float = 5.0) -> bool:
         """Block until no in-flight request references the generation —
         the ``draining`` step that lets a flip retire the old model."""
@@ -630,12 +641,18 @@ def create_prediction_server_app(
 
     @app.route("GET", "/status\\.json")
     def status(req: Request) -> Response:
+        batcher = getattr(app, "microbatcher", None)
         return json_response(
             200,
             {
                 "status": "alive",
                 "engineInstanceId": deployed.instance.id,
                 "startTime": started_at.isoformat(),
+                # the fleet drain surface: a quiesced replica is safe to
+                # stop when no generation holds an in-flight request and
+                # the micro-batch queue is idle
+                "inflightGenerations": deployed.inflight_snapshot(),
+                "batcherBusy": bool(batcher is not None and batcher.busy),
                 **stats,
             },
         )

@@ -197,9 +197,40 @@ def _status_remote(
                 "signatures; see docs/observability.md#device-efficiency)",
                 file=sys.stderr,
             )
+    # fleet surface (404/401-tolerant): when the probed daemon is a fleet
+    # router, fold the membership registry — any ejected replica is an
+    # operator-actionable WARNING, and a fleet with zero healthy replicas
+    # cannot serve at all (exit 1 even if the router process is alive)
+    fleet_dead = False
+    fl_status, fleet_body = fetch("/fleet.json")
+    if fl_status == 200 and isinstance(fleet_body.get("replicas"), list):
+        report["fleet"] = {
+            "total": fleet_body.get("total"),
+            "healthy": fleet_body.get("healthy"),
+            "routable": fleet_body.get("routable"),
+        }
+        for r in fleet_body["replicas"]:
+            if r.get("draining"):
+                continue
+            if not r.get("healthy") or r.get("breaker") == "open":
+                why = (
+                    r.get("last_probe_error")
+                    or f"breaker {r.get('breaker')}"
+                )
+                print(
+                    f"WARNING: replica {r.get('replica')} ejected from "
+                    f"routing ({why}; see docs/fleet.md#ejection)",
+                    file=sys.stderr,
+                )
+        if not fleet_body.get("healthy"):
+            fleet_dead = True
     _print(report)
     alive = health_status == 200 and health.get("status") == "alive"
-    return 0 if alive and ready_status == 200 and not drifting else 1
+    return (
+        0
+        if alive and ready_status == 200 and not drifting and not fleet_dead
+        else 1
+    )
 
 
 def do_app(args) -> int:
@@ -987,6 +1018,220 @@ def do_capacity(args) -> int:
     )
 
 
+def _render_fleet_text(body: dict) -> str:
+    """Human one-screen rendering of a /fleet.json body."""
+    lines = [
+        f"fleet: {body.get('name', 'fleet')} — "
+        f"{body.get('total', 0)} replicas, "
+        f"{body.get('healthy', 0)} healthy, "
+        f"{body.get('routable', 0)} routable",
+    ]
+    for r in body.get("replicas", []):
+        state = "ok"
+        if r.get("draining"):
+            state = "draining"
+        elif not r.get("healthy"):
+            state = "EJECTED"
+        elif r.get("breaker") == "open":
+            state = "BREAKER-OPEN"
+        cap = r.get("capacity") or {}
+        headroom = cap.get("headroom_frac")
+        lines.append(
+            f"  {r.get('replica'):<22} {state:<13} "
+            f"breaker={r.get('breaker', '?'):<9} "
+            f"inflight={r.get('inflight', 0):<3} "
+            f"headroom="
+            + (f"{headroom:.0%}" if isinstance(headroom, (int, float)) else "n/a")
+            + (
+                f"  ({r['last_probe_error']})"
+                if r.get("last_probe_error") and not r.get("healthy")
+                else ""
+            )
+        )
+    auto = body.get("autoscaler")
+    if auto:
+        pol = auto.get("policy", {})
+        lines.append(
+            "autoscaler: enabled "
+            f"[{pol.get('min_replicas')}..{pol.get('max_replicas')}] "
+            + (
+                f"pinned at {auto['target_override']}"
+                if auto.get("target_override") is not None
+                else "capacity-driven"
+            )
+        )
+        last = auto.get("last_event")
+        if last:
+            lines.append(
+                f"  last event: {last.get('event')} "
+                + " ".join(
+                    f"{k}={v}" for k, v in sorted(last.items())
+                    if k not in ("event", "at")
+                )
+            )
+    return "\n".join(lines)
+
+
+def _fleet_deploy(args) -> int:
+    """`pio fleet deploy`: spawn N replica daemons through the pio deploy
+    machinery, then run the router in the foreground (Ctrl-C tears the
+    whole stack down)."""
+    from predictionio_tpu.fleet.autoscaler import (
+        Autoscaler,
+        AutoscalerPolicy,
+        LocalProcessSpawner,
+    )
+    from predictionio_tpu.fleet.membership import FleetState
+    from predictionio_tpu.fleet.router import create_router_app
+    from predictionio_tpu.server.httpd import AppServer
+
+    if args.replicas < 1:
+        print("usage error: --replicas must be >= 1", file=sys.stderr)
+        return 2
+    deploy_args: list[str] = []
+    if args.engine:
+        deploy_args += ["--engine", args.engine]
+    if getattr(args, "engine_json", None):
+        deploy_args += ["--engine-json", args.engine_json]
+    if args.accesskey:
+        deploy_args += ["--accesskey", args.accesskey]
+    if getattr(args, "deadline_s", None) is not None:
+        deploy_args += ["--deadline-s", str(args.deadline_s)]
+    spawner = LocalProcessSpawner(
+        deploy_args,
+        host=args.replica_ip,
+        base_port=args.replica_base_port,
+    )
+    # NOTE: no source_file here — the spawner owns this fleet's membership;
+    # an inherited PIO_FLEET_FILE would fight it (the first refresh would
+    # replace the spawned replicas with the file's stale contents)
+    fleet = FleetState(
+        name=args.name,
+        access_key=args.accesskey or None,
+    )
+    server = None
+    autoscaler = None
+    try:
+        for i in range(args.replicas):
+            url = spawner.spawn()
+            fleet.add(url)
+            print(f"replica {i + 1}/{args.replicas} ready at {url}")
+        fleet.probe_once()
+        fleet.start()
+        if args.autoscale:
+            policy = AutoscalerPolicy.from_env()
+            if args.min_replicas is not None or args.max_replicas is not None:
+                import dataclasses
+
+                policy = dataclasses.replace(
+                    policy,
+                    min_replicas=args.min_replicas or policy.min_replicas,
+                    max_replicas=args.max_replicas or policy.max_replicas,
+                )
+            autoscaler = Autoscaler(fleet, spawner, policy=policy)
+            autoscaler.start()
+        server_ref: list = []
+
+        def on_stop():
+            if server_ref:
+                server_ref[0].shutdown()
+
+        app = create_router_app(
+            fleet,
+            access_key=args.accesskey or None,
+            default_deadline_s=getattr(args, "deadline_s", None),
+            max_inflight=getattr(args, "max_inflight", None),
+            autoscaler=autoscaler,
+            on_stop=on_stop,
+        )
+        server = AppServer(app, args.ip, args.port)
+        server_ref.append(server)
+        print(
+            f"Router on http://{args.ip}:{server.port} "
+            f"(POST /queries.json; GET /fleet.json)"
+        )
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+    finally:
+        if autoscaler is not None:
+            autoscaler.stop()
+        fleet.stop()
+        if server is not None:
+            server.shutdown()
+        spawner.stop_all()
+        print("fleet stopped")
+    return 0
+
+
+def do_fleet(args) -> int:
+    """`pio fleet`: deploy/status/scale/watch a router + replica fleet."""
+    if args.fleet_command == "deploy":
+        return _fleet_deploy(args)
+
+    if args.fleet_command == "scale":
+        import urllib.error
+        import urllib.request
+
+        url = (
+            args.url.rstrip("/")
+            + f"/fleet/scale?replicas={args.replicas}"
+        )
+        headers = {}
+        if getattr(args, "access_key", None):
+            headers["Authorization"] = f"Bearer {args.access_key}"
+        try:
+            req = urllib.request.Request(url, headers=headers, method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                body = json.loads(r.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            print(
+                f"scale refused ({e.code}): {e.read().decode('utf-8', 'replace')}",
+                file=sys.stderr,
+            )
+            return 1
+        except Exception as e:
+            print(f"router unreachable: {e}", file=sys.stderr)
+            return 1
+        mode = body.get("mode", "?")
+        print(
+            f"fleet target: {body.get('target') if mode == 'pinned' else 'auto'} "
+            f"({mode})"
+        )
+        return 0
+
+    # status / watch: read /fleet.json
+    last_body: dict = {}
+
+    def render_once() -> None:
+        body = json.loads(
+            _fetch_url(
+                args.url.rstrip("/") + "/fleet.json",
+                getattr(args, "access_key", None),
+            )
+        )
+        last_body.clear()
+        last_body.update(body)
+        print(
+            json.dumps(body, indent=2)
+            if getattr(args, "json", False)
+            else _render_fleet_text(body)
+        )
+
+    watch = args.watch if args.fleet_command == "watch" else None
+    rc = _run_watched(
+        "pio fleet", render_once, watch, getattr(args, "watch_count", None)
+    )
+    if rc != 0:
+        return rc
+    # one-shot status: exit 1 when the fleet cannot serve at all
+    if args.fleet_command == "status" and last_body.get("routable", 0) == 0:
+        print("error: zero routable replicas", file=sys.stderr)
+        return 1
+    return 0
+
+
 def do_profile(args) -> int:
     """`pio profile`: capture a profile of a running server (or this
     process).
@@ -1752,6 +1997,65 @@ def build_parser() -> argparse.ArgumentParser:
         help=argparse.SUPPRESS,  # bounded --watch iterations (tests)
     )
     cp.set_defaults(fn=do_capacity)
+
+    fl = sub.add_parser(
+        "fleet",
+        help="router + replica fleet: deploy/status/scale/watch",
+        description="Horizontal fleet layer (docs/fleet.md): deploy a "
+        "consistent-hash router in front of N prediction-server replica "
+        "daemons, read the membership registry, or pin the autoscaler "
+        "target.",
+    )
+    flsub = fl.add_subparsers(dest="fleet_command", required=True)
+    fld = flsub.add_parser(
+        "deploy",
+        help="spawn N replica daemons and run the router in the foreground",
+    )
+    fld.add_argument("--engine")
+    fld.add_argument("--engine-json", default=None)
+    fld.add_argument("--replicas", type=int, default=2)
+    fld.add_argument("--ip", default="0.0.0.0", help="router bind address")
+    fld.add_argument("--port", type=int, default=8000, help="router port")
+    fld.add_argument(
+        "--replica-ip",
+        default="127.0.0.1",
+        help="address replicas bind (the internal tier; default loopback)",
+    )
+    fld.add_argument(
+        "--replica-base-port",
+        type=int,
+        default=None,
+        help="first replica port (consecutive from here; default ephemeral)",
+    )
+    fld.add_argument("--accesskey", default="")
+    fld.add_argument("--name", default="fleet", help="fleet label in /fleet.json")
+    fld.add_argument("--deadline-s", type=float, default=None)
+    fld.add_argument("--max-inflight", type=int, default=None)
+    fld.add_argument(
+        "--autoscale",
+        action="store_true",
+        help="run the capacity-driven autoscaler loop (PIO_FLEET_* knobs; "
+        "see docs/fleet.md#autoscaler)",
+    )
+    fld.add_argument("--min-replicas", type=int, default=None)
+    fld.add_argument("--max-replicas", type=int, default=None)
+    fls = flsub.add_parser("status", help="read a running router's /fleet.json")
+    fls.add_argument("--url", required=True)
+    fls.add_argument("--access-key", default=None)
+    fls.add_argument("--json", action="store_true")
+    flc = flsub.add_parser(
+        "scale", help="pin the autoscaler target (N or 'auto')"
+    )
+    flc.add_argument("replicas", help="replica count to pin, or 'auto'")
+    flc.add_argument("--url", required=True)
+    flc.add_argument("--access-key", default=None)
+    flw = flsub.add_parser("watch", help="re-render /fleet.json periodically")
+    flw.add_argument("--url", required=True)
+    flw.add_argument("--access-key", default=None)
+    flw.add_argument("--json", action="store_true")
+    flw.add_argument("--watch", type=float, default=2.0)
+    flw.add_argument("--watch-count", type=int, default=None, help=argparse.SUPPRESS)
+    fl.set_defaults(fn=do_fleet)
 
     pf = sub.add_parser(
         "profile",

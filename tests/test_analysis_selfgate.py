@@ -207,6 +207,35 @@ def test_hostprofile_modules_lint_clean_with_zero_pragmas():
     assert baselined == []
 
 
+def test_fleet_modules_lint_clean_with_zero_pragmas():
+    """The fleet layer — membership.py (replica registry + prober),
+    router.py (the proxy hot path), autoscaler.py (the capacity-loop
+    controller) — must be `pio check`-clean with NO pragma suppressions
+    and NO baseline entries: the router forwards every serving request,
+    so a busy-wait, an un-timed socket, or an unlocked mutation here is a
+    fleet-wide defect, not a module-local one."""
+    files = [
+        PACKAGE / "fleet" / "__init__.py",
+        PACKAGE / "fleet" / "membership.py",
+        PACKAGE / "fleet" / "router.py",
+        PACKAGE / "fleet" / "autoscaler.py",
+    ]
+    report = analyze_paths(files, root=REPO_ROOT)
+    assert report.errors == []
+    assert report.findings == [], "\n".join(f.text() for f in report.findings)
+    assert report.pragma_suppressed == 0
+    names = {
+        "predictionio_tpu/fleet/__init__.py",
+        "predictionio_tpu/fleet/membership.py",
+        "predictionio_tpu/fleet/router.py",
+        "predictionio_tpu/fleet/autoscaler.py",
+    }
+    baselined = [
+        e for e in Baseline.load(BASELINE).entries if e.file in names
+    ]
+    assert baselined == []
+
+
 def test_conc003_recognizes_contended_lock_wrappers():
     """Adopting ContendedLock/ContendedCondition on a hot lock must NOT
     silently retire the unlocked-mutation check for the state it guards:
