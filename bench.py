@@ -293,6 +293,20 @@ def ncf_ranking_metrics(
     tru, tri = train_u[tro], train_i[tro]
     teo = np.argsort(test_u, kind="stable")
     teu, tei = test_u[teo], test_i[teo]
+    # size the candidate list to the HEAVIEST eval user's blacklist UP
+    # FRONT (next pow2 of max_seen + K): the per-user fallback below then
+    # never fires — BENCH_r05's "ncf eval full-row fallbacks: 2" was two
+    # users whose train history exhausted the fixed 2048 menu
+    if len(eval_users):
+        max_seen = int(
+            (
+                np.searchsorted(tru, eval_users, "right")
+                - np.searchsorted(tru, eval_users, "left")
+            ).max()
+        )
+        need = max_seen + K
+        if need > cand:
+            cand = min(1 << (need - 1).bit_length(), n_items)
 
     triples = []
     B = 512
@@ -312,9 +326,14 @@ def ncf_ranking_metrics(
         for row in range(min(B, len(eval_users) - c0)):
             u = users[row]
             seen = frozenset(tri[lo[row] : hi[row]].tolist())
-            if len(seen) > cand - K:
+            if len(seen) > cand - K and cand < n_items:
                 # candidate list could be exhausted by the blacklist:
-                # exact fallback on the full score row
+                # exact fallback on the full score row — COUNTED
+                # (pio_topk_full_row_fallback_total) and shape-logged; the
+                # up-front cand sizing above should make this unreachable
+                from predictionio_tpu.ops.topk import note_full_row_fallback
+
+                note_full_row_fallback(1, cand, n_items, "ncf.eval")
                 full = np.asarray(
                     topc(ncf_params, jnp.asarray([u] * 1, jnp.int32),
                          n_items, n_items)
@@ -364,6 +383,43 @@ def ncf_serving_p50(model, num_users, n=200):
         assert r.item_scores
     lat.sort()
     return lat[len(lat) // 2] * 1000
+
+
+def ncf_solo_e2e_p50(model, num_users, n=60, depth=4):
+    """Solo end-to-end WALL including dispatch, through the async pipelined
+    path (the PR 12 target): per-query completion interval at steady state
+    with ``depth`` unfenced queries in flight.  BENCH_r05 measured a solo
+    device query behind a ~102 ms tunnel/dispatch RTT because every query
+    paid the full dispatch->fence round trip; with dispatch_batch the next
+    query's dispatch overlaps this one's fence, so the steady-state
+    per-query wall collapses toward the device cost."""
+    from collections import deque
+
+    from predictionio_tpu.models.ncf.engine import NCFAlgorithm, Query
+
+    algo = NCFAlgorithm()
+
+    def dispatch(q):
+        fin = algo.dispatch_batch(
+            model, [(0, Query(user=str(q % num_users), num=K))]
+        )
+        assert fin is not None
+        return fin
+
+    dispatch(0)()  # compile + warm
+    pend: deque = deque()
+    done_t = []
+    for q in range(n):
+        pend.append(dispatch(q))
+        if len(pend) > depth:
+            pend.popleft()()
+            done_t.append(time.perf_counter())
+    while pend:
+        pend.popleft()()
+        done_t.append(time.perf_counter())
+    intervals = np.diff(np.asarray(done_t)) * 1000
+    intervals.sort()
+    return float(intervals[len(intervals) // 2])
 
 
 def tunnel_rtt_ms(n=30):
@@ -1404,6 +1460,14 @@ def main() -> None:
                                         num_users)
         metrics["ncf_serving_p50_ms"] = round(ncf_p50, 3)
         metrics["ncf_solo_device_ms"] = round(ncf_dev_ms, 3)
+        # solo e2e wall INCLUDING dispatch through the pipelined async
+        # path — the headline the ~100 ms tunnel RTT used to hide behind
+        solo_e2e = ncf_solo_e2e_p50(ncf_model, num_users)
+        metrics["serving_solo_e2e_p50_ms"] = round(solo_e2e, 3)
+        log(
+            f"# serving_solo_e2e_p50={solo_e2e:.3f}ms (pipelined async "
+            f"dispatch, depth 4; vs tunnel RTT p50 above)"
+        )
         # device-level wave cost: 50 DISTINCT 32-query micro-batch waves
         # dispatched back-to-back with one final sync — pipelining
         # amortizes this dev box's ~100 ms tunnel round trip out of the
@@ -1494,6 +1558,76 @@ def main() -> None:
             f"serving_p50_concurrent32={p50_conc:.3f}ms "
             f"p99_concurrent32={p99_conc:.3f}ms (target <10ms)"
         )
+        # repeat-entity factor-cache effectiveness: two passes over the
+        # same 100 users through the engine solo path — pass 2 should be
+        # ~all hits (the millions-of-users common case is repeat entities)
+        from predictionio_tpu.models.recommendation.engine import (
+            ALSAlgorithm,
+            Query as ALSQuery,
+        )
+        from predictionio_tpu.parallel import device_cache
+
+        algo = ALSAlgorithm()
+        s0 = device_cache.stats()
+        for _ in range(2):
+            for u in range(100):
+                algo.predict(model, ALSQuery(user=str(u), num=K))
+        s1 = device_cache.stats()
+        hits = s1["hits_total"] - s0["hits_total"]
+        gets = hits + s1["misses_total"] - s0["misses_total"]
+        metrics["factor_cache_hit_rate"] = round(
+            hits / gets if gets else 0.0, 4
+        )
+        log(f"# factor_cache_hit_rate={metrics['factor_cache_hit_rate']}")
+
+    def sec_fused_topk():
+        # fused score+top-k roofline: 50 pipelined 32-query launches with
+        # one dependent sync (tunnel RTT amortized out), vs the kernel's
+        # analytic bytes/flops — pallas bodies are opaque to XLA
+        # cost_analysis, same as the ALS train kernel
+        import jax.numpy as _jnp
+
+        from predictionio_tpu.obs.device import (
+            device_peaks,
+            utilization_frac,
+        )
+        from predictionio_tpu.ops.topk import (
+            fused_topk_batch,
+            fused_topk_roofline,
+        )
+
+        U = _jnp.asarray(np.asarray(C.state.user_factors))
+        V = _jnp.asarray(np.asarray(C.state.item_factors))
+        rank = int(V.shape[1])
+        kf = 16
+        waves = [
+            _jnp.asarray((np.arange(32) * 131 + w * 37) % num_users,
+                         _jnp.int32)
+            for w in range(51)
+        ]
+        device_sync(fused_topk_batch(U[waves[0]], V, kf,
+                                     name="bench.fused_topk"))
+        t0 = time.perf_counter()
+        outs = [
+            fused_topk_batch(U[w], V, kf, name="bench.fused_topk")
+            for w in waves[1:]
+        ]
+        device_sync(outs[-1])
+        per_launch_s = (time.perf_counter() - t0) / 50
+        rl = fused_topk_roofline(32, rank, int(V.shape[0]), kf)
+        peaks = device_peaks()
+        gbps = rl["bytes"] / per_launch_s / 1e9
+        metrics["fused_topk_wave32_ms"] = round(per_launch_s * 1000, 3)
+        metrics["fused_topk_achieved_gb_s"] = round(gbps, 2)
+        metrics["fused_topk_hbm_utilization_frac"] = round(
+            utilization_frac(gbps, peaks.hbm_gbps), 4
+        )
+        log(
+            f"# fused_topk wave32={per_launch_s * 1000:.3f}ms "
+            f"achieved={gbps:.1f} GB/s "
+            f"({metrics['fused_topk_hbm_utilization_frac']:.1%} of HBM "
+            f"peak ~{peaks.hbm_gbps:.0f})"
+        )
 
     # --devices N: the sharded scaling section (model-parallel serving +
     # data-parallel train over an N-device mesh; subprocess-isolated)
@@ -1553,6 +1687,7 @@ def main() -> None:
         run_section("event_store", sec_event_store)
         if hasattr(C, "state"):
             run_section("als_serving", sec_als_serving)
+            run_section("fused_topk", sec_fused_topk)
         else:
             failed.append("als_serving")
             log("# SECTION als_serving SKIPPED: no trained ALS state")
@@ -1581,6 +1716,14 @@ def main() -> None:
         if train_s is not None else None,
     }
     out.update(metrics)
+    # every full-score-row top-k fallback any section hit (the fused menu
+    # should cover them all: the gateable claim is this staying 0)
+    from predictionio_tpu.obs.metrics import REGISTRY
+
+    fam = REGISTRY.get("pio_topk_full_row_fallback_total")
+    out["topk_full_row_fallbacks"] = (
+        int(sum(c.value for _, c in fam.series())) if fam is not None else 0
+    )
     if failed:
         out["failed_sections"] = failed
     print(json.dumps(out))

@@ -330,6 +330,77 @@ class ReshardInHotLoop(Rule):
                         )
 
 
+#: function-name fragments marking the PRE-FENCE half of a pipelined wave:
+#: dispatch_batch (engine async halves), _dispatch_wave (MicroBatcher), any
+#: *dispatch* helper on the serving path.  Nested ``def``s inside them (the
+#: finalize closures) are the fence region and are exempt — that is exactly
+#: where the sync belongs.
+_DISPATCH_FRAGMENT = "dispatch"
+
+#: explicit sync spellings that stall the pipeline when they run before the
+#: fence (np.asarray/np.array are NOT listed: on host lists they are the
+#: normal gather idiom and carry no device sync)
+_DISPATCH_SYNC_CALLS = frozenset(
+    ("jax.block_until_ready", "jax.device_get")
+)
+
+
+@rule
+class DispatchRegionSync(Rule):
+    """PIO-JAX007: host sync inside the dispatch (pre-fence) region."""
+
+    id = "PIO-JAX007"
+    severity = Severity.MEDIUM
+    summary = (
+        "block_until_ready/.item()/device_get inside a dispatch-phase "
+        "function; the sync belongs at the finalize fence"
+    )
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if _DISPATCH_FRAGMENT not in fn.name:
+                continue
+            # walk_skipping_defs: nested defs (the finalize closures) are
+            # the post-fence region — syncs there are the design
+            for node in walk_skipping_defs(fn.body):
+                if not isinstance(node, ast.Call):
+                    continue
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready"
+                ):
+                    yield self.finding(
+                        mod,
+                        node,
+                        f"block_until_ready() in dispatch-phase function "
+                        f"{fn.name!r} blocks the worker before the fence; "
+                        "return the pending result and sync in the "
+                        "finalize closure instead",
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item"
+                    and not node.args
+                ):
+                    yield self.finding(
+                        mod,
+                        node,
+                        f".item() in dispatch-phase function {fn.name!r} "
+                        "forces a device->host sync before the fence; "
+                        "defer the read to the finalize closure",
+                    )
+                elif resolve_call(mod, node) in _DISPATCH_SYNC_CALLS:
+                    yield self.finding(
+                        mod,
+                        node,
+                        f"{resolve_call(mod, node)}(...) in dispatch-phase "
+                        f"function {fn.name!r} synchronizes before the "
+                        "fence; the dispatch half must stay non-blocking",
+                    )
+
+
 @rule
 class JitMutableDefault(Rule):
     """PIO-JAX005: jitted function with a mutable (unhashable) default arg."""

@@ -346,6 +346,11 @@ class FleetState:
                         log.info("replica %s re-admitted", rep.replica_id)
                     rep.consecutive_probe_failures = 0
                     rep.healthy = True
+                    # a ready answer is positive proof of liveness: close
+                    # the replica's breaker NOW instead of waiting out its
+                    # reset window — "a replica that answers ready IS
+                    # ready" must hold for routable(), not just healthy
+                    rep.breaker.reset()
                 else:
                     rep.consecutive_probe_failures += 1
                     if (

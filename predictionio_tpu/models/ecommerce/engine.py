@@ -35,6 +35,7 @@ from predictionio_tpu.core.base import (
 from predictionio_tpu.core.engine import Engine, engine_factory
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.models.filters import CategoryIndex, exclude_mask
+from predictionio_tpu.obs import device as device_obs
 from predictionio_tpu.ops.als import ALSParams, train_als
 from predictionio_tpu.ops.similarity import cosine_topk, dot_topk
 from predictionio_tpu.resilience.degrade import mark_degraded
@@ -361,6 +362,28 @@ class ECommAlgorithm(Algorithm):
             categories=query.categories,
         )
 
+    def _user_row(self, model: ECommModel, user: str):
+        """The user's factor row as a DEVICE-resident array, cached per
+        model: the cold path materializes the whole host copy of the user
+        table and re-uploads one row per query — a repeat user skips both
+        transfers entirely (the row never leaves HBM between requests).
+        The cache dies with the model object, so a generation swap can
+        never serve a stale row (parallel/device_cache.py)."""
+        from predictionio_tpu.parallel import device_cache
+
+        cache = device_cache.model_cache(model)
+        row = cache.get(user)
+        if row is not None:
+            device_obs.note_cache_hit()
+            return row
+        uidx = model.user_vocab.get(user)
+        if uidx is None:
+            return None
+        with device_obs.wave_stage("host_gather"):
+            row = jnp.asarray(np.asarray(model.user_factors)[uidx])
+        cache.put(user, row)
+        return row
+
     # -- predict -------------------------------------------------------------
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
         # NOTE: serving-time event-store reads put a storage RTT inside the
@@ -369,10 +392,10 @@ class ECommAlgorithm(Algorithm):
         black = self._gen_black_list(ctx, query)
         exclude = self._exclude_mask(model, query, black)
         k = min(query.num, len(model.item_vocab))
-        uidx = model.user_vocab.get(query.user)
-        if uidx is not None:
+        qrow = self._user_row(model, query.user)
+        if qrow is not None:
             scores, idx = dot_topk(
-                jnp.asarray(np.asarray(model.user_factors)[uidx]),
+                qrow,
                 jnp.asarray(model.item_factors),
                 jnp.asarray(exclude),
                 k,

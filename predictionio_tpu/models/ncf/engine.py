@@ -148,7 +148,7 @@ def _packable_n_items(model: "NCFModel") -> int:
     return n_items
 
 
-def _host_score_topk(hp: dict, uidx: int, n_items: int, k: int):
+def _host_score_topk(hp: dict, uidx: int, n_items: int, k: int, ue=None):
     """numpy replica of ops.ncf.score_all_items + top-k for ONE user.
 
     Solo queries serve from the host: a device dispatch costs a full
@@ -156,13 +156,18 @@ def _host_score_topk(hp: dict, uidx: int, n_items: int, k: int):
     and still ~ms on a TPU-VM), while this [n_items, hidden] numpy MLP is
     sub-ms at catalog scale.  The wave path (batch_predict /
     _score_topk_batch) stays on device where batching amortizes the
-    dispatch.  Mirrors the ALS template's host-replica solo serving."""
+    dispatch.  Mirrors the ALS template's host-replica solo serving.
+    ``ue`` (the user's embedding row) may arrive pre-gathered from the
+    factor cache — repeat users skip the table read entirely."""
     if "out_w" not in hp:  # pure GMF (mlp_layers=())
-        score = hp["item_emb"] @ hp["user_emb"][uidx] + hp["out_b"][0]
+        if ue is None:
+            ue = hp["user_emb"][uidx]
+        score = hp["item_emb"] @ ue + hp["out_b"][0]
     else:
         d = hp["user_emb"].shape[1] // 2
         n_full = hp["item_emb"].shape[0]
-        ue = hp["user_emb"][uidx]
+        if ue is None:
+            ue = hp["user_emb"][uidx]
         gmf = ue[None, :d] * hp["item_emb"][:, :d]
         h = np.concatenate(
             [np.broadcast_to(ue[d:], (n_full, d)), hp["item_emb"][:, d:]],
@@ -318,14 +323,30 @@ class NCFAlgorithm(Algorithm):
     def predict(self, model: NCFModel, query: Query) -> PredictedResult:
         """Solo query from the HOST replica: no device dispatch, so no
         per-query device round trip (the wave path in batch_predict stays
-        on device, where batching amortizes it)."""
-        uidx = model.user_vocab.get(query.user)
-        if uidx is None:
-            return PredictedResult()
+        on device, where batching amortizes it).  Repeat users serve their
+        embedding row from the per-model factor cache — the vocab + table
+        gather is skipped entirely on a hit (flight gather stage ~ 0)."""
+        from predictionio_tpu.parallel import device_cache
+
+        cache = device_cache.model_cache(model)
+        hit = cache.get(query.user)
+        if hit is None:
+            with device_obs.wave_stage("host_gather"):
+                uidx = model.user_vocab.get(query.user)
+                if uidx is None:
+                    return PredictedResult()
+                uidx = int(uidx)
+                # host_params is the numpy replica: a row .copy() here is
+                # a 40-byte memcpy, not a device sync
+                ue = model.host_params["user_emb"][uidx].copy()
+            cache.put(query.user, (uidx, ue))
+        else:
+            uidx, ue = hit
+            device_obs.note_cache_hit()
         n_items = len(model.item_vocab)
         k = min(query.num, n_items)
         scores, items = _host_score_topk(
-            model.host_params, int(uidx), n_items, k
+            model.host_params, uidx, n_items, k, ue=ue
         )
         return PredictedResult(
             item_scores=tuple(
@@ -442,6 +463,14 @@ class NCFAlgorithm(Algorithm):
     def _predict_wave(self, model: NCFModel, iq):
         if not iq:
             return []
+        if model.shards is None:
+            # the synchronous wave IS the async half fenced immediately:
+            # ONE copy of the dispatch logic (gather, pow2 menu,
+            # signature, h2d, cost capture) serves both the pipelined and
+            # inline paths, so they can never silently diverge.  The wave
+            # is <= MAX_WAVE and unsharded here, so dispatch never
+            # declines.
+            return self.dispatch_batch(model, iq)()
         n_items = _packable_n_items(model)
         with device_obs.wave_stage("host_gather"):
             uidx = np.array(
@@ -456,52 +485,10 @@ class NCFAlgorithm(Algorithm):
             b = max(1 << (len(iq) - 1).bit_length(), 32)
             padded = np.zeros(b, np.int32)
             padded[: len(iq)] = np.maximum(uidx, 0)
-        if model.shards is not None:
-            packed = self._sharded_packed_topk(model, padded, n_items, k, b)
-        else:
-            # shapes past the padding menu still compile (a client sweeping
-            # `num` walks k through every power of two): account every
-            # signature so churn shows up as a recompile storm, not a
-            # mystery.  The table shape is part of the key — two deployed
-            # models must not share cost/compile entries.
-            eff = device_obs.default_efficiency()
-            sig = (b, k, n_items) + tuple(
-                model.state.params["user_emb"].shape
-            )
-            device_obs.default_recompiles().note_signature(
-                "ncf.batch_predict", sig
-            )
-            with device_obs.wave_stage("h2d"):
-                users_dev = jnp.asarray(padded)
-                device_obs.note_transfer("h2d", padded.nbytes)
-            # deferred: the AOT cost-analysis compile runs on a daemon
-            # thread, concurrent with the jit cache's own compile of this
-            # signature — never inside the wave's deadline
-            eff.capture_cost(
-                "ncf.batch_predict",
-                _score_topk_batch,
-                model.state.params,
-                users_dev,
-                n_items,
-                k,
-                signature=sig,
-                defer=True,
-            )
-            t_dev = time.perf_counter()
-            with device_obs.wave_stage("compute"):
-                packed_dev = _score_topk_batch(
-                    model.state.params, users_dev, n_items, k
-                )
-                packed_dev.block_until_ready()
-            compute_s = time.perf_counter() - t_dev
-            device_obs.note_wave_device(device_obs.device_label(packed_dev))
-            device_obs.note_wave_cost(
-                "ncf.batch_predict", eff.cached_cost("ncf.batch_predict", sig)
-            )
-            with device_obs.wave_stage("d2h"):
-                packed = np.asarray(packed_dev)
-                device_obs.note_transfer("d2h", packed.nbytes)
-            eff.observe("ncf.batch_predict", compute_s, signature=sig)
+        packed = self._sharded_packed_topk(model, padded, n_items, k, b)
+        return self._render_wave(model, iq, uidx, packed)
+
+    def _render_wave(self, model: NCFModel, iq, uidx, packed):
         top_s = packed[0]
         top_i = packed[1].astype(np.int64)
         out = []
@@ -527,6 +514,61 @@ class NCFAlgorithm(Algorithm):
                 )
             )
         return out
+
+    def dispatch_batch(self, model: NCFModel, indexed_queries):
+        """The MicroBatcher pipeline's async half: vocab gather + pow2
+        padding + h2d + the wave kernel dispatch run NOW without blocking;
+        the returned finalize fences (block_until_ready), reads the packed
+        winners back, and renders.  Declines (None) for sharded serving
+        (the settle clock is synchronous) and waves past MAX_WAVE."""
+        iq = list(indexed_queries)
+        if not iq or len(iq) > self.MAX_WAVE or model.shards is not None:
+            return None
+        n_items = _packable_n_items(model)
+        with device_obs.wave_stage("host_gather"):
+            uidx = np.array(
+                [model.user_vocab.get(q.user, -1) for _, q in iq], np.int32
+            )
+            want_k = min(max(q.num for _, q in iq), n_items)
+            k = min(max(1 << (want_k - 1).bit_length(), 16), n_items)
+            b = max(1 << (len(iq) - 1).bit_length(), 32)
+            padded = np.zeros(b, np.int32)
+            padded[: len(iq)] = np.maximum(uidx, 0)
+        eff = device_obs.default_efficiency()
+        sig = (b, k, n_items) + tuple(model.state.params["user_emb"].shape)
+        device_obs.default_recompiles().note_signature(
+            "ncf.batch_predict", sig
+        )
+        with device_obs.wave_stage("h2d"):
+            users_dev = jnp.asarray(padded)
+            device_obs.note_transfer("h2d", padded.nbytes)
+        eff.capture_cost(
+            "ncf.batch_predict", _score_topk_batch, model.state.params,
+            users_dev, n_items, k, signature=sig, defer=True,
+        )
+        t_dev = time.perf_counter()
+        packed_dev = _score_topk_batch(model.state.params, users_dev,
+                                       n_items, k)
+
+        def finalize():
+            with device_obs.wave_stage("compute"):
+                packed_dev.block_until_ready()
+            # dispatch-to-ready: under pipelining this window overlaps the
+            # NEXT wave's dispatch — that overlap IS the win the stage
+            # clocks prove
+            compute_s = time.perf_counter() - t_dev
+            device_obs.note_wave_device(device_obs.device_label(packed_dev))
+            device_obs.note_wave_cost(
+                "ncf.batch_predict",
+                eff.cached_cost("ncf.batch_predict", sig),
+            )
+            with device_obs.wave_stage("d2h"):
+                packed = np.asarray(packed_dev)
+                device_obs.note_transfer("d2h", packed.nbytes)
+            eff.observe("ncf.batch_predict", compute_s, signature=sig)
+            return self._render_wave(model, iq, uidx, packed)
+
+        return finalize
 
     def make_persistent_model(self, ctx: EngineContext, model: NCFModel):
         out = {

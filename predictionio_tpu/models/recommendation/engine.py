@@ -36,7 +36,14 @@ from predictionio_tpu.core.warmstart import align_warm_factors, find_warm_start
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.obs import device as device_obs
 from predictionio_tpu.ops.als import ALSParams, ALSState, train_als
-from predictionio_tpu.ops.topk import host_topk, host_topk_batch
+from predictionio_tpu.ops.topk import (
+    fused_supported,
+    fused_topk_batch,
+    host_topk,
+    host_topk_batch,
+    note_full_row_fallback,
+)
+from predictionio_tpu.parallel import device_cache
 
 # ---------------------------------------------------------------------------
 # Data types
@@ -341,13 +348,26 @@ class ALSAlgorithm(Algorithm):
         A [n_items] matvec + argpartition is ~0.1 ms at ML-20M scale and
         keeps p50 flat even when the device queue is congested; concurrent
         queries coalesce into the device ``batch_predict`` path via the
-        serving MicroBatcher instead."""
-        uidx = model.user_vocab.get(query.user)
-        if uidx is None:
-            return PredictedResult()  # unknown user (reference returns empty)
+        serving MicroBatcher instead.  Repeat users skip the factor gather
+        entirely: their row comes from the per-model factor cache
+        (parallel/device_cache.py), so the flight entry's gather stage is
+        ~0 on a hit — and a generation swap swaps the cache with the model,
+        so a stale row can never serve."""
+        cache = device_cache.model_cache(model)
+        row = cache.get(query.user)
+        if row is None:
+            with device_obs.wave_stage("host_gather"):
+                uidx = model.user_vocab.get(query.user)
+                if uidx is None:
+                    # unknown user (reference returns empty)
+                    return PredictedResult()
+                row = model.host_factors()[0][uidx]
+            cache.put(query.user, row)
+        else:
+            device_obs.note_cache_hit()
         k = min(query.num, len(model.item_vocab))
-        U, V = model.host_factors()
-        scores, idx = host_topk(V @ U[uidx], k)
+        V = model.host_factors()[1]
+        scores, idx = host_topk(V @ row, k)
         return PredictedResult(
             item_scores=tuple(
                 ItemScore(item=model.item_vocab.inverse(int(i)), score=float(s))
@@ -395,6 +415,25 @@ class ALSAlgorithm(Algorithm):
         sig = (b, k_pad, n_items, bound.n_shards) + tuple(
             bound.arrays["item_factors"].shape
         )
+        # per-shard FUSED local top-k when the shape is on the menu: each
+        # device's local [B, rows_local] score block never materializes
+        # (only the fused kernel's tile-wide slab) — proof in both
+        # LAST_KERNEL_SHAPES hooks.  Off the menu, the score-then-top_k
+        # local path still runs and is counted as a full-row fallback.
+        rows_local = int(bound.arrays["item_factors"].shape[0]) // max(
+            bound.n_shards, 1
+        )
+        use_fused = fused_supported(b, min(k_pad, rows_local), rows_local)
+        if not use_fused:
+            note_full_row_fallback(b, k_pad, n_items, "als.sharded_topk")
+
+        def _fused_local(item_local, q, kc, limit):
+            packed = fused_topk_batch(
+                q, item_local, kc, limit=limit,
+                name="als.sharded_topk.fused",
+            )
+            return packed[0], packed[1].astype(jnp.int32)
+
         kernel = bound.kernel(
             (b, k_pad),
             lambda: build_sharded_topk(
@@ -405,6 +444,7 @@ class ALSAlgorithm(Algorithm):
                 n_items=n_items,
                 k=k_pad,
                 name="als.sharded_topk",
+                local_topk_fn=_fused_local if use_fused else None,
             ),
         )
 
@@ -432,81 +472,200 @@ class ALSAlgorithm(Algorithm):
     #: matmul wins (throughput-bound eval batches)
     DEVICE_BATCH_MIN = 512
 
-    def batch_predict(self, model: ALSModel, queries):
-        """Vectorized path: one [B, rank] x [rank, n_items] matmul."""
+    def _split_known(self, model: ALSModel, queries):
         known = [(i, model.user_vocab.get(q.user)) for i, q in queries]
-        rows = [(i, u, q) for (i, q), (_, u) in zip(queries, known) if u is not None]
-        out = [
+        rows = [
+            (i, u, q)
+            for (i, q), (_, u) in zip(queries, known)
+            if u is not None
+        ]
+        missing = [
             (i, PredictedResult())
             for (i, q), (_, u) in zip(queries, known)
             if u is None
         ]
+        return rows, missing
+
+    def _render_rows(self, model: ALSModel, rows, top_s, top_i):
+        out = []
+        for row, (i, _, q) in enumerate(rows):
+            n = min(q.num, len(model.item_vocab))
+            out.append(
+                (
+                    i,
+                    PredictedResult(
+                        item_scores=tuple(
+                            ItemScore(
+                                item=model.item_vocab.inverse(int(ii)),
+                                score=float(ss),
+                            )
+                            for ii, ss in zip(top_i[row, :n], top_s[row, :n])
+                        )
+                    ),
+                )
+            )
+        return out
+
+    def _host_topk_rows(self, model: ALSModel, rows, k: int):
+        """Host-replica wave: per-entity user rows from the factor cache
+        (repeat entities skip the gather — counted on the wave timeline),
+        misses gathered once and cached, then one [B, rank] x [rank, n]
+        numpy matmul + batched top-k."""
+        cache = device_cache.model_cache(model)
+        qrows: list[Any] = [None] * len(rows)
+        miss_j: list[int] = []
+        hits = 0
+        for j, (_, _, q) in enumerate(rows):
+            row = cache.get(q.user)
+            if row is None:
+                miss_j.append(j)
+            else:
+                qrows[j] = row
+                hits += 1
+        if hits:
+            device_obs.note_cache_hit(hits)
+        if miss_j:
+            with device_obs.wave_stage("host_gather"):
+                Uh = model.host_factors()[0]
+                for j in miss_j:
+                    row = np.array(Uh[rows[j][1]])
+                    qrows[j] = row
+                    cache.put(rows[j][2].user, row)
+        Vh = model.host_factors()[1]
+        return host_topk_batch(np.stack(qrows) @ Vh.T, k)
+
+    def _device_topk(self, model: ALSModel, uidx: np.ndarray, k: int):
+        """Dispatch the device top-k WITHOUT blocking; returns the fence
+        callable that blocks, reads back, and hands over (top_s, top_i) —
+        the async half the MicroBatcher pipeline overlaps.  Fused kernel
+        when the shape is on the menu (no [B, n_items] score row, see
+        ops/topk.py); otherwise the materialized-row kernel, counted."""
+        eff = device_obs.default_efficiency()
+        with device_obs.wave_stage("h2d"):
+            # count the bytes that actually cross: numpy factors
+            # (a freshly persisted model) upload whole matrices,
+            # device-resident factors upload nothing
+            uploaded = uidx.nbytes + sum(
+                a.nbytes
+                for a in (model.user_factors, model.item_factors)
+                if not hasattr(a, "devices")
+            )
+            U = jnp.asarray(model.user_factors)
+            V = jnp.asarray(model.item_factors)
+            uidx_dev = jnp.asarray(uidx)
+            device_obs.note_transfer("h2d", uploaded)
+        from predictionio_tpu.ops.topk import fused_topk_roofline
+
+        if fused_supported(len(uidx), k, int(V.shape[0])):
+            # factor shapes are part of the key — two deployed models
+            # (different rank / vocab) must not share cost entries
+            sig = ("fused", len(uidx), k) + tuple(U.shape) + tuple(V.shape)
+            device_obs.default_recompiles().note_signature(
+                "als.fused_topk", sig
+            )
+            packed = fused_topk_batch(
+                U[uidx_dev], V, k, name="als.fused_topk"
+            )
+
+            def fence():
+                with device_obs.wave_stage("compute"):
+                    packed.block_until_ready()
+                device_obs.note_wave_device(
+                    device_obs.device_label(packed)
+                )
+                # pallas bodies are opaque to XLA cost_analysis: the
+                # analytic roofline stands in (same as the ALS train
+                # kernel's source="plan")
+                device_obs.note_wave_cost(
+                    "als.fused_topk",
+                    fused_topk_roofline(
+                        len(uidx), int(U.shape[1]), int(V.shape[0]), k
+                    ),
+                )
+                with device_obs.wave_stage("d2h"):
+                    arr = np.asarray(packed)
+                    device_obs.note_transfer("d2h", arr.nbytes)
+                return arr[0], arr[1].astype(np.int64)
+
+            return fence
+        note_full_row_fallback(
+            len(uidx), k, int(V.shape[0]), "als.batch_topk"
+        )
+        sig = (len(uidx), k) + tuple(U.shape) + tuple(V.shape)
+        device_obs.default_recompiles().note_signature("als.batch_topk", sig)
+        eff.capture_cost(
+            "als.batch_topk", _device_score_topk, U, V, uidx_dev, k,
+            signature=sig, defer=True,
+        )
+        t_dev = time.perf_counter()
+        top = _device_score_topk(U, V, uidx_dev, k)
+
+        def fence_full():
+            with device_obs.wave_stage("compute"):
+                top[0].block_until_ready()
+            compute_s = time.perf_counter() - t_dev
+            device_obs.note_wave_device(device_obs.device_label(top[0]))
+            device_obs.note_wave_cost(
+                "als.batch_topk", eff.cached_cost("als.batch_topk", sig)
+            )
+            with device_obs.wave_stage("d2h"):
+                top_s, top_i = np.asarray(top[0]), np.asarray(top[1])
+                device_obs.note_transfer(
+                    "d2h", top_s.nbytes + top_i.nbytes
+                )
+            eff.observe("als.batch_topk", compute_s, signature=sig)
+            return top_s, top_i
+
+        return fence_full
+
+    def batch_predict(self, model: ALSModel, queries):
+        """Vectorized path: one fused (or [B, rank] x [rank, n_items])
+        device dispatch, or the host replica below DEVICE_BATCH_MIN."""
+        rows, out = self._split_known(model, queries)
         if rows:
             uidx = np.asarray([u for _, u, _ in rows], np.int32)
             k = max(min(q.num, len(model.item_vocab)) for _, _, q in rows)
             if model.shards is not None:
                 top_s, top_i = self._sharded_topk(model, uidx, k)
             elif len(rows) >= self.DEVICE_BATCH_MIN:
-                eff = device_obs.default_efficiency()
-                with device_obs.wave_stage("h2d"):
-                    # count the bytes that actually cross: numpy factors
-                    # (a freshly persisted model) upload whole matrices,
-                    # device-resident factors upload nothing
-                    uploaded = uidx.nbytes + sum(
-                        a.nbytes
-                        for a in (model.user_factors, model.item_factors)
-                        if not hasattr(a, "devices")
-                    )
-                    U = jnp.asarray(model.user_factors)
-                    V = jnp.asarray(model.item_factors)
-                    uidx_dev = jnp.asarray(uidx)
-                    device_obs.note_transfer("h2d", uploaded)
-                # factor shapes are part of the key — two deployed models
-                # (different rank / vocab) must not share cost entries
-                sig = (len(rows), k) + tuple(U.shape) + tuple(V.shape)
-                device_obs.default_recompiles().note_signature(
-                    "als.batch_topk", sig
-                )
-                eff.capture_cost(
-                    "als.batch_topk", _device_score_topk, U, V, uidx_dev, k,
-                    signature=sig, defer=True,
-                )
-                t_dev = time.perf_counter()
-                with device_obs.wave_stage("compute"):
-                    top_s, top_i = _device_score_topk(U, V, uidx_dev, k)
-                    top_s.block_until_ready()
-                compute_s = time.perf_counter() - t_dev
-                device_obs.note_wave_device(device_obs.device_label(top_s))
-                device_obs.note_wave_cost(
-                    "als.batch_topk", eff.cached_cost("als.batch_topk", sig)
-                )
-                with device_obs.wave_stage("d2h"):
-                    top_s, top_i = np.asarray(top_s), np.asarray(top_i)
-                    device_obs.note_transfer(
-                        "d2h", top_s.nbytes + top_i.nbytes
-                    )
-                eff.observe("als.batch_topk", compute_s, signature=sig)
+                top_s, top_i = self._device_topk(model, uidx, k)()
             else:
-                with device_obs.wave_stage("host_gather"):
-                    Uh, Vh = model.host_factors()
-                    top_s, top_i = host_topk_batch(Uh[uidx] @ Vh.T, k)
-            for row, (i, _, q) in enumerate(rows):
-                n = min(q.num, len(model.item_vocab))
-                out.append(
-                    (
-                        i,
-                        PredictedResult(
-                            item_scores=tuple(
-                                ItemScore(
-                                    item=model.item_vocab.inverse(int(ii)),
-                                    score=float(ss),
-                                )
-                                for ii, ss in zip(top_i[row, :n], top_s[row, :n])
-                            )
-                        ),
-                    )
-                )
+                top_s, top_i = self._host_topk_rows(model, rows, k)
+            out.extend(self._render_rows(model, rows, top_s, top_i))
         return out
+
+    def dispatch_batch(self, model: ALSModel, indexed_queries):
+        """The MicroBatcher pipeline's async half (docs/performance.md):
+        vocab gather and the device dispatch run NOW (no blocking); the
+        returned finalize fences, reads back, and renders.  Declines
+        (None) for sharded serving (synchronous settle clock) and for
+        host-replica waves (no dispatch to overlap — and the worker being
+        busy is what drives natural batching)."""
+        iq = list(indexed_queries)
+        if model.shards is not None or len(iq) < self.DEVICE_BATCH_MIN:
+            # sharded waves: the settle clock is synchronous by design.
+            # Host-replica waves: there is no device dispatch to overlap,
+            # and moving the CPU scoring off the worker would DESTROY
+            # natural batching (the worker being busy is what lets queue
+            # pressure coalesce the next wave) — measured: wave sizes
+            # collapse to 1 and concurrent p50 regresses 7x.  Decline;
+            # the wave computes inline on the worker as before.
+            return None
+        with device_obs.wave_stage("host_gather"):
+            rows, missing = self._split_known(model, iq)
+        if not rows:
+            return lambda: list(missing)
+        uidx = np.asarray([u for _, u, _ in rows], np.int32)
+        k = max(min(q.num, len(model.item_vocab)) for _, _, q in rows)
+        if len(rows) < self.DEVICE_BATCH_MIN:
+            return None  # mostly-unknown wave fell under the device floor
+        fence = self._device_topk(model, uidx, k)
+
+        def finalize():
+            top_s, top_i = fence()
+            return missing + self._render_rows(model, rows, top_s, top_i)
+
+        return finalize
 
     # -- persistence ---------------------------------------------------------
     def make_persistent_model(self, ctx: EngineContext, model: ALSModel):

@@ -605,7 +605,7 @@ class WaveTimeline:
 
     __slots__ = (
         "stages", "device", "fn", "flops", "bytes", "transfers", "shards",
-        "shard_seconds",
+        "shard_seconds", "cache_hits",
     )
 
     def __init__(self):
@@ -615,6 +615,10 @@ class WaveTimeline:
         self.flops: float = 0.0
         self.bytes: float = 0.0
         self.transfers: dict[str, float] = {}
+        #: factor-cache hits inside this wave (note_cache_hit): a repeat
+        #: entity whose gather was skipped — flows into per-item meta as
+        #: ``cache_hits`` so flight entries prove gather ~ 0 on a hit
+        self.cache_hits: int = 0
         #: per-device byte/shard attribution of a SHARDED wave (filled by
         #: note_wave_shards; flows into per-item meta -> flight entries)
         self.shards: dict[str, dict[str, float]] = {}
@@ -670,6 +674,14 @@ def note_wave_device(label: str) -> None:
     tl = _timeline_var.get()
     if tl is not None:
         tl.device = label
+
+
+def note_cache_hit(n: int = 1) -> None:
+    """Record ``n`` factor-cache hits on the current wave (no-op outside a
+    wave scope) — the per-request twin of pio_factor_cache_hits_total."""
+    tl = _timeline_var.get()
+    if tl is not None:
+        tl.cache_hits += n
 
 
 def note_wave_cost(fn: str, cost: Mapping[str, float] | None) -> None:
@@ -791,9 +803,11 @@ def als_plan_roofline(plan: Mapping[str, Any]) -> dict[str, float] | None:
 #: ``sharded_*`` metrics + the ``sharded_devices`` config echo the gate
 #: refuses to cross-compare); v4 adds the ``--fleet N`` router section
 #: (``fleet_*`` metrics + the ``fleet_replicas`` config echo, same
-#: cross-compare refusal).  ``pio bench --compare`` refuses version-less
-#: or older files.
-BENCH_SCHEMA_VERSION = 4
+#: cross-compare refusal); v5 adds the solo async-dispatch e2e number
+#: (``serving_solo_e2e_p50_ms`` — wall INCLUDING dispatch, the PR 12
+#: target), ``factor_cache_hit_rate``, and the fused-topk roofline block.
+#: ``pio bench --compare`` refuses version-less or older files.
+BENCH_SCHEMA_VERSION = 5
 
 #: regression-gateable BENCH metrics and which direction is better.  Only
 #: keys present in BOTH files are compared; everything else (configuration
@@ -806,6 +820,9 @@ BENCH_GATE_METRICS: dict[str, str] = {
     "serving_p50_ms": "lower",
     "serving_p50_concurrent32_ms": "lower",
     "serving_p99_concurrent32_ms": "lower",
+    # solo end-to-end WALL including dispatch through the pipelined async
+    # path — the number the ~100 ms tunnel RTT used to hide behind
+    "serving_solo_e2e_p50_ms": "lower",
     "ncf_serving_p50_ms": "lower",
     "ncf_solo_device_ms": "lower",
     "ncf_wave32_pipelined_ms": "lower",
@@ -821,6 +838,10 @@ BENCH_GATE_METRICS: dict[str, str] = {
     "ncf_epochs_per_s": "higher",
     "roofline_achieved_gb_s": "higher",
     "roofline_achieved_tflop_s": "higher",
+    # repeat-entity factor-cache effectiveness + fused-topk roofline
+    "factor_cache_hit_rate": "higher",
+    "fused_topk_achieved_gb_s": "higher",
+    "fused_topk_hbm_utilization_frac": "higher",
     # sharded section (bench --devices N): lower is better
     "sharded_train_s": "lower",
     "sharded_serving_p50_ms": "lower",

@@ -247,6 +247,7 @@ def build_sharded_topk(
     k: int,
     axis: str = "model",
     name: str = "sharded_topk",
+    local_topk_fn: Callable[..., tuple] | None = None,
 ):
     """Compile a factor-sharded top-k: ``fn(params..., queries) -> [2, B, k]``.
 
@@ -265,6 +266,15 @@ def build_sharded_topk(
     4. replicated merge to the packed ``[2, B, k]`` f32 layout (row 0
        scores, row 1 item ids — one D2H transfer, ids exact below 2^24).
 
+    ``local_topk_fn(*local_params, queries, kc, limit)``, when given,
+    replaces steps 1-2 with a FUSED per-shard kernel (ops/topk.py): it
+    returns ``(values [B, kc], local_ids [B, kc])`` directly, masking local
+    rows at or past ``limit`` (a traced scalar — the catalog tail on the
+    last shard), so no device ever materializes even its local score block:
+    the largest live slab per shard is the fused kernel's tile.  Tie order
+    must match ``lax.top_k`` (value desc, id asc) — the fused kernel's
+    contract — so the merged result stays bit-identical either way.
+
     Returns the jitted callable; callers cache per (mesh, shapes, k) the
     same way the engines cache their unsharded kernels.
     """
@@ -274,22 +284,35 @@ def build_sharded_topk(
 
     def body(*args):
         *params, queries = args
-        scores = local_scores_fn(*params, queries)  # [B, rows_local]
-        rows_local = scores.shape[-1]
+        rows_local = params[0].shape[0]
+        kc = min(k, rows_local)
+        base = jax.lax.axis_index(axis) * rows_local
+        if local_topk_fn is not None:
+            # fused per-shard path: the local [B, rows_local] score block
+            # never exists — only the kernel's [B, tile] slab does
+            limit = jnp.clip(n_items - base, 0, rows_local)
+            v, li = local_topk_fn(*params, queries, kc, limit)
+            gi = li.astype(jnp.int32) + base
+            shapes = {"fused": 1}
+        else:
+            scores = local_scores_fn(*params, queries)  # [B, rows_local]
+            rows_local = scores.shape[-1]
+            kc = min(k, rows_local)
+            gidx = base + jnp.arange(rows_local, dtype=jnp.int32)
+            scores = jnp.where(gidx[None, :] < n_items, scores, -jnp.inf)
+            # equal scores: lowest local row
+            v, i = jax.lax.top_k(scores, kc)
+            gi = (i.astype(jnp.int32) + base)[..., :kc]
+            shapes = {"fused": 0}
         # the per-shard shape contract: each device scores only its slice
         LAST_KERNEL_SHAPES[name] = {
             "rows_local": int(rows_local),
-            "batch": int(scores.shape[0]),
+            "batch": int(queries.shape[0]),
             "k": int(k),
             "n_shards": n_shards,
             "n_items": int(n_items),
+            **shapes,
         }
-        base = jax.lax.axis_index(axis) * rows_local
-        gidx = base + jnp.arange(rows_local, dtype=jnp.int32)
-        scores = jnp.where(gidx[None, :] < n_items, scores, -jnp.inf)
-        kc = min(k, rows_local)
-        v, i = jax.lax.top_k(scores, kc)  # equal scores: lowest local row
-        gi = (i.astype(jnp.int32) + base)[..., :kc]
         if kc < k:  # a shard owns fewer rows than k: pad its candidate list
             v = jnp.pad(v, ((0, 0), (0, k - kc)), constant_values=-jnp.inf)
             gi = jnp.pad(gi, ((0, 0), (0, k - kc)))

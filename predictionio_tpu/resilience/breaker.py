@@ -110,6 +110,19 @@ class CircuitBreaker:
                 self._state = CLOSED
                 self._gauge.set(BREAKER_STATES[CLOSED])
 
+    def reset(self) -> None:
+        """Force-close on out-of-band positive proof of health (the fleet
+        prober's successful /readyz probe): the reset window exists to
+        pace blind retries, not to overrule an actual observed answer —
+        without this a revived replica can sit unroutable (breaker open)
+        while its /readyz already says ready."""
+        with self._lock:
+            self._failures = 0
+            self._trials = 0
+            if self._state != CLOSED:
+                self._state = CLOSED
+                self._gauge.set(BREAKER_STATES[CLOSED])
+
     def release_trial(self) -> None:
         """A half-open trial ended with neither a success nor an endpoint
         failure (e.g. the caller's deadline ran out mid-call): free the

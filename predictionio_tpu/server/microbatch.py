@@ -29,6 +29,19 @@ Resilience semantics (docs/robustness.md):
 - a ``batch_fn`` exception on a multi-item wave triggers ONE bounded
   solo-retry pass, so a poison query fails alone instead of failing its
   wave-mates.
+
+**Pipelined dispatch** (docs/performance.md): a ``batch_fn`` that returns a
+:class:`PendingWave` splits the wave into a *dispatch* half (parse, entity
+gather, h2d, async device dispatch — everything up to the fence) and a
+*finalize* half (``block_until_ready``/d2h/serialize) that runs on a
+dedicated finalizer thread.  The worker is then immediately free to
+dispatch wave N+1 while wave N's finalize drains — parse→gather→h2d of the
+next wave overlaps compute of the current one, MPMD-pipelining style
+(arXiv 2412.14374), bounded by ``max_inflight_waves``.  Results resolve in
+wave order (single FIFO finalizer); deadline, solo-retry, and close()
+semantics are identical to the synchronous path, and per-item meta carries
+the ``dispatch_s``/``finalize_s`` split plus ``pipelined: True`` so the
+stage clocks prove exactly what moved off the critical path.
 """
 
 from __future__ import annotations
@@ -71,6 +84,33 @@ from predictionio_tpu.resilience.deadline import _now as _deadline_now
 log = logging.getLogger("predictionio_tpu.microbatch")
 
 
+class PendingWave:
+    """A dispatched-but-unfenced wave: ``batch_fn`` returns one of these
+    when it has already done the pre-fence work (parse/gather/h2d + async
+    JAX dispatch, NO blocking) and defers the fence.  ``finalize()`` runs
+    on the MicroBatcher's finalizer thread, blocks until the device results
+    land, and returns one result per item in order — the only place the
+    pipeline is allowed to synchronize (the serialize fence)."""
+
+    __slots__ = ("finalize",)
+
+    def __init__(self, finalize: Callable[[], Sequence[Any]]):
+        self.finalize = finalize
+
+
+class _InflightWave:
+    """One dispatched wave waiting for its finalize fence."""
+
+    __slots__ = (
+        "live", "pending", "wave_seq", "loop", "t_dispatch", "wave_t0",
+        "dispatch_s", "timeline", "wave_deadline", "depth_at_enqueue",
+    )
+
+    def __init__(self, **kw):
+        for name, value in kw.items():
+            setattr(self, name, value)
+
+
 class MicroBatcher:
     """Coalesce ``submit``-ed items into batched ``batch_fn`` calls.
 
@@ -94,9 +134,14 @@ class MicroBatcher:
         registry: MetricsRegistry | None = None,
         max_queue: int | None = 1024,
         solo_retry: bool = True,
+        max_inflight_waves: int = 2,
     ):
         self.batch_fn = batch_fn
         self.max_batch = max_batch
+        #: pipelined waves allowed between dispatch and the finalize fence;
+        #: 0 finalizes inline on the worker (pipelining off — the pre-PR-13
+        #: serial behavior, useful for tests and debugging)
+        self.max_inflight_waves = max(int(max_inflight_waves), 0)
         #: how long close() waits for the in-flight wave before abandoning
         #: the daemon worker (was a hard-coded 5.0 s deadline)
         self.drain_timeout_s = drain_timeout_s
@@ -122,6 +167,12 @@ class MicroBatcher:
         self._worker: threading.Thread | None = None
         self._in_wave = False
         self._closed = False
+        #: dispatched waves waiting for their finalize fence (FIFO: results
+        #: resolve in wave order) + the finalizer's busy flag — close() and
+        #: ``busy`` treat an unfenced wave exactly like an in-flight one
+        self._inflight: deque[_InflightWave] = deque()
+        self._finalizing = False
+        self._finalizer: threading.Thread | None = None
         #: wave-size histogram for the status page ({batch_size: count})
         self.wave_sizes: dict[int, int] = {}
         #: rolling window of recent wave sizes feeding the coalescing-rate
@@ -201,11 +252,17 @@ class MicroBatcher:
 
     @property
     def busy(self) -> bool:
-        """True while queries are queued or a wave is mid-dispatch — the
-        queue-side half of the fleet drain check (the generation-refcount
-        half lives on DeployedEngine.inflight_snapshot)."""
+        """True while queries are queued, a wave is mid-dispatch, or a
+        pipelined wave awaits its finalize fence — the queue-side half of
+        the fleet drain check (the generation-refcount half lives on
+        DeployedEngine.inflight_snapshot)."""
         with self._cond:
-            return bool(self._pending) or self._in_wave
+            return (
+                bool(self._pending)
+                or self._in_wave
+                or bool(self._inflight)
+                or self._finalizing
+            )
 
     async def submit(self, item: Any, meta: dict | None = None) -> Any:
         """Queue ``item`` for the next wave.  ``meta``, when given, is
@@ -250,7 +307,10 @@ class MicroBatcher:
                     target=self._drain, name="microbatch", daemon=True
                 )
                 self._worker.start()
-            self._cond.notify()
+            # notify_all, not notify: the worker AND the pipeline finalizer
+            # sleep on this condition — a single notify could wake only the
+            # finalizer (which has nothing to do) and strand the new item
+            self._cond.notify_all()
         return await fut
 
     def close(self) -> None:
@@ -283,12 +343,16 @@ class MicroBatcher:
                 # the futures' loop is already closed (server tore the
                 # loop down first) — nothing can await them anymore
                 pass
-        # sleep on the condition until the worker clears _in_wave (it
-        # notifies at end of wave) instead of polling: wakeup is immediate
-        # and no CPU burns while a long device dispatch drains
+        # sleep on the condition until the worker clears _in_wave AND the
+        # pipeline drains (the finalizer notifies after every fence) instead
+        # of polling: wakeup is immediate and no CPU burns while a long
+        # device dispatch drains
         with self._cond:
             if not self._cond.wait_for(
-                lambda: not self._in_wave, timeout=self.drain_timeout_s
+                lambda: not self._in_wave
+                and not self._inflight
+                and not self._finalizing,
+                timeout=self.drain_timeout_s,
             ):
                 self._m_drain_timeout.inc()
 
@@ -316,18 +380,37 @@ class MicroBatcher:
                     self._in_wave = False
                     self._cond.notify_all()  # wake close() waiters
 
-    def _call_batch_fn(self, items: list[Any]) -> Sequence[Any]:
+    def _call_batch_fn(self, items: list[Any]):
         """The batch_fn fault-injection seam (docs/robustness.md); one
-        attribute check when no plan is installed."""
+        attribute check when no plan is installed.  May return either the
+        results or a :class:`PendingWave` (pipelined dispatch)."""
         if faults.ACTIVE is not None:
             faults.ACTIVE.check("batch_fn", self._fault_label)
-        results = self.batch_fn(items)
+        return self.batch_fn(items)
+
+    def _validated(self, results, items: list[Any]) -> Sequence[Any]:
         if len(results) != len(items):
             raise RuntimeError(
                 f"batch_fn returned {len(results)} results "
                 f"for {len(items)} items"
             )
         return results
+
+    def _run_batch_sync(self, items: list[Any]) -> Sequence[Any]:
+        """Dispatch + finalize inline — the solo-retry path (and any other
+        caller that needs the whole wave on one thread)."""
+        results = self._call_batch_fn(items)
+        if isinstance(results, PendingWave):
+            results = results.finalize()
+        return self._validated(results, items)
+
+    def _fail_or_retry(
+        self, live: list[tuple], e: BaseException, wave_seq: int, loop
+    ) -> None:
+        if len(live) == 1 or not self.solo_retry:
+            self._post(loop, [f for _, f, *_ in live], None, e)
+        else:
+            self._solo_retry_pass(live, e, wave_seq)
 
     def _dispatch_wave(self, wave: list[tuple], wave_seq: int) -> None:
         t_dispatch = time.perf_counter()
@@ -390,41 +473,215 @@ class MicroBatcher:
                 with deadline_scope(absolute=wave_deadline):
                     with _wave_context(live[0]):
                         results = self._call_batch_fn(items)
-            device_s = time.perf_counter() - t_dispatch
-            self._m_device_time.observe(device_s)
-            breakdown = self._observe_timeline(timeline, device_s)
-            # fill per-item timing meta BEFORE resolving the futures:
-            # call_soon_threadsafe orders these writes before the
-            # submitter's read on the loop thread
-            for _, _, t_enq, _, meta, _, _ in live:
-                if meta is not None:
-                    meta["queue_wait_s"] = round(t_dispatch - t_enq, 6)
-                    meta["device_s"] = round(device_s, 6)
-                    meta["device_breakdown"] = breakdown
-                    meta["wave_device"] = timeline.device
-                    #: wall-clock dispatch time — the distributed timeline's
-                    #: anchor for the wave's device-track events
-                    meta["wave_t0"] = round(wave_t0, 6)
-                    if timeline.fn:
-                        meta["wave_fn"] = timeline.fn
-                        meta["wave_flops"] = timeline.flops
-                        meta["wave_bytes"] = timeline.bytes
-                    if timeline.shards:
-                        # sharded wave: which devices held which bytes
-                        meta["wave_shards"] = timeline.shards
-                    if timeline.shard_seconds:
-                        # ... and each device's own settle clock
-                        meta["wave_shard_seconds"] = timeline.shard_seconds
-                    meta["wave_size"] = len(items)
-                    meta["wave_seq"] = wave_seq
-                    meta["wave_request_ids"] = rids
-            self._note_wave(len(items))
-            self._post(loop, futures, results, None)
         except Exception as e:
-            if len(live) == 1 or not self.solo_retry:
-                self._post(loop, futures, None, e)
+            self._fail_or_retry(live, e, wave_seq, loop)
+            return
+        if isinstance(results, PendingWave):
+            # pipelined wave: the fence moves to the finalizer thread and
+            # THIS thread is immediately free to dispatch the next wave —
+            # the parse→gather→h2d / compute / d2h-serialize overlap
+            job = _InflightWave(
+                live=live,
+                pending=results,
+                wave_seq=wave_seq,
+                loop=loop,
+                t_dispatch=t_dispatch,
+                wave_t0=wave_t0,
+                dispatch_s=time.perf_counter() - t_dispatch,
+                timeline=timeline,
+                wave_deadline=wave_deadline,
+                depth_at_enqueue=0,
+            )
+            if self.max_inflight_waves > 0:
+                self._enqueue_inflight(job)
             else:
-                self._solo_retry_pass(live, e, wave_seq)
+                self._finalize_wave(job)
+            return
+        try:
+            results = self._validated(results, items)
+        except Exception as e:
+            self._fail_or_retry(live, e, wave_seq, loop)
+            return
+        device_s = time.perf_counter() - t_dispatch
+        self._m_device_time.observe(device_s)
+        breakdown = self._observe_timeline(timeline, device_s)
+        self._fill_meta(
+            live, t_dispatch, device_s, breakdown, timeline, wave_t0,
+            wave_seq, rids,
+        )
+        self._note_wave(len(items))
+        self._post(loop, futures, results, None)
+
+    def _fill_meta(
+        self,
+        live: list[tuple],
+        t_dispatch: float,
+        device_s: float,
+        breakdown: dict[str, float],
+        timeline: "device_obs.WaveTimeline",
+        wave_t0: float,
+        wave_seq: int,
+        rids: list[str],
+        extra: dict | None = None,
+    ) -> None:
+        """Fill per-item timing meta BEFORE resolving the futures:
+        call_soon_threadsafe orders these writes before the submitter's
+        read on the loop thread."""
+        for _, _, t_enq, _, meta, _, _ in live:
+            if meta is not None:
+                meta["queue_wait_s"] = round(t_dispatch - t_enq, 6)
+                meta["device_s"] = round(device_s, 6)
+                meta["device_breakdown"] = breakdown
+                meta["wave_device"] = timeline.device
+                #: wall-clock dispatch time — the distributed timeline's
+                #: anchor for the wave's device-track events
+                meta["wave_t0"] = round(wave_t0, 6)
+                if timeline.fn:
+                    meta["wave_fn"] = timeline.fn
+                    meta["wave_flops"] = timeline.flops
+                    meta["wave_bytes"] = timeline.bytes
+                if timeline.shards:
+                    # sharded wave: which devices held which bytes
+                    meta["wave_shards"] = timeline.shards
+                if timeline.shard_seconds:
+                    # ... and each device's own settle clock
+                    meta["wave_shard_seconds"] = timeline.shard_seconds
+                if timeline.cache_hits:
+                    # factor-cache hits in this wave: a repeat entity whose
+                    # gather was skipped (flight entries prove gather ~ 0)
+                    meta["cache_hits"] = timeline.cache_hits
+                meta["wave_size"] = len(live)
+                meta["wave_seq"] = wave_seq
+                meta["wave_request_ids"] = rids
+                if extra:
+                    meta.update(extra)
+
+    # -- pipelined finalize ---------------------------------------------------
+
+    def _enqueue_inflight(self, job: _InflightWave) -> None:
+        """Hand a dispatched wave to the finalizer, blocking while the
+        in-flight depth is at the bound (bounded pipelining: the worker
+        must not run unboundedly ahead of the fence)."""
+        with self._cond:
+            while (
+                len(self._inflight) >= self.max_inflight_waves
+                and not self._closed
+            ):
+                self._cond.wait()
+            if self._closed:
+                # close() raced this dispatch: an idle finalizer may have
+                # already seen (closed, empty) and exited — enqueueing now
+                # would strand the wave's futures forever.  Finalize
+                # inline instead: close() is still waiting on _in_wave.
+                closed = True
+            else:
+                closed = False
+                job.depth_at_enqueue = len(self._inflight) + 1
+                self._inflight.append(job)
+                if self._finalizer is None or not self._finalizer.is_alive():
+                    self._finalizer = threading.Thread(
+                        target=self._finalize_loop,
+                        name="microbatch-finalize",
+                        daemon=True,
+                    )
+                    self._finalizer.start()
+                self._cond.notify_all()
+        if closed:
+            self._finalize_wave(job)
+
+    def _finalize_loop(self) -> None:
+        """FIFO fence runner: results resolve in wave order, one wave's
+        finalize at a time, overlapping the worker's next dispatch."""
+        while True:
+            with self._cond:
+                while not self._inflight and not self._closed:
+                    self._cond.wait()
+                if not self._inflight:
+                    return  # closed and drained
+                job = self._inflight.popleft()
+                self._finalizing = True
+                self._cond.notify_all()  # wake a worker blocked on depth
+            try:
+                self._finalize_wave(job)
+            finally:
+                with self._cond:
+                    self._finalizing = False
+                    self._cond.notify_all()  # wake close() waiters
+
+    def _finalize_wave(self, job: _InflightWave) -> None:
+        live = job.live
+        items = [it for it, _, _, _, _, _, _ in live]
+        futures = [f for _, f, _, _, _, _, _ in live]
+        rids = [r for _, _, _, r, _, _, _ in live if r]
+        # deadline re-check at the fence: an item whose budget ran out while
+        # its wave sat in the in-flight pipeline (behind a slow finalize)
+        # must still answer an honest 504, exactly like expiry in the
+        # dispatch queue — the device work is sunk, the lie is not.  The
+        # finalize itself still runs (it releases serving slots).
+        now = _deadline_now()
+        expired: set[int] = set()
+        for j, (_, _, _, _, meta, dl, _tc) in enumerate(live):
+            if dl is not None and dl <= now:
+                self._m_expired.inc()
+                if meta is not None:
+                    meta["deadline_expired"] = True
+                expired.add(j)
+        t_fin = time.perf_counter()
+        try:
+            with device_obs.wave_timeline() as ftl:
+                with deadline_scope(absolute=job.wave_deadline):
+                    with _wave_context(live[0]):
+                        results = self._validated(
+                            job.pending.finalize(), items
+                        )
+        except Exception as e:
+            self._fail_or_retry(live, e, job.wave_seq, job.loop)
+            return
+        if expired:
+            for j in sorted(expired, reverse=True):
+                _post_one(
+                    live[j][1],
+                    error=DeadlineExceeded(
+                        "query deadline expired while pipelined behind "
+                        "the in-flight wave"
+                    ),
+                )
+            live = [e for j, e in enumerate(live) if j not in expired]
+            results = [r for j, r in enumerate(results) if j not in expired]
+            futures = [f for _, f, _, _, _, _, _ in live]
+            if not live:
+                return
+        finalize_s = time.perf_counter() - t_fin
+        device_s = job.dispatch_s + finalize_s
+        self._m_device_time.observe(device_s)
+        # merge the dispatch-phase stage marks into the finalize timeline:
+        # one breakdown covering both halves (host_gather/h2d from
+        # dispatch, compute/d2h from the fence)
+        dtl = job.timeline
+        for stage, seconds in dtl.stages.items():
+            ftl.stages[stage] = ftl.stages.get(stage, 0.0) + seconds
+        if ftl.fn is None:
+            ftl.fn, ftl.flops, ftl.bytes = dtl.fn, dtl.flops, dtl.bytes
+        if ftl.device == "host" and dtl.device != "host":
+            ftl.device = dtl.device
+        ftl.cache_hits += dtl.cache_hits
+        if not ftl.shards:
+            ftl.shards = dtl.shards
+        if not ftl.shard_seconds:
+            ftl.shard_seconds = dtl.shard_seconds
+        breakdown = self._observe_timeline(ftl, device_s)
+        self._fill_meta(
+            live, job.t_dispatch, device_s, breakdown, ftl, job.wave_t0,
+            job.wave_seq, rids,
+            extra={
+                "pipelined": True,
+                "dispatch_s": round(job.dispatch_s, 6),
+                "finalize_s": round(finalize_s, 6),
+                "inflight_depth": job.depth_at_enqueue,
+            },
+        )
+        self._note_wave(len(items))
+        self._post(job.loop, futures, results, None)
 
     def _note_wave(self, size: int) -> None:
         """Record one dispatched wave's size — under the cond (the status
@@ -494,7 +751,9 @@ class MicroBatcher:
                 with device_obs.wave_timeline() as timeline:
                     with deadline_scope(absolute=dl):
                         with _wave_context(entry):
-                            result = self._call_batch_fn([item])[0]
+                            # dispatch + finalize inline: a retried item
+                            # never re-enters the pipeline
+                            result = self._run_batch_sync([item])[0]
             except Exception as e:
                 _post_one(fut, error=e)
                 continue
@@ -514,6 +773,8 @@ class MicroBatcher:
                     meta["wave_shards"] = timeline.shards
                 if timeline.shard_seconds:
                     meta["wave_shard_seconds"] = timeline.shard_seconds
+                if timeline.cache_hits:
+                    meta["cache_hits"] = timeline.cache_hits
                 meta["wave_size"] = 1
                 meta["wave_seq"] = wave_seq
                 meta["solo_retry"] = True

@@ -190,12 +190,21 @@ class DeployedEngine:
         return Binding(instance, params, algos, models, serving, role)
 
     def _install_live(self, binding: Binding) -> None:
+        old_models = getattr(self, "models", None)
         with self._lock:
             self.instance = binding.instance
             self.params = binding.params
             self.algorithms = binding.algorithms
             self.models = binding.models
             self.serving = binding.serving
+        # the retired generation's factor caches die with it: a repeat
+        # entity's next request gathers from the NEW generation's factors
+        # (stale rows can never serve — chaos-asserted byte-identical vs a
+        # cold cache)
+        if old_models is not None and old_models is not binding.models:
+            from predictionio_tpu.parallel import device_cache
+
+            device_cache.invalidate_model_caches(old_models, "swap")
 
     def _bind(self, instance: EngineInstance) -> None:
         self._install_live(self.load_binding(instance))
@@ -253,25 +262,39 @@ class DeployedEngine:
 
     # -- in-flight tracking (the drain half of a swap) -----------------------
 
-    @contextlib.contextmanager
-    def serving_slot(self, binding: Binding):
+    def acquire_slot(self, binding: Binding) -> None:
+        """Take one in-flight ref on the binding's generation.  Split from
+        :meth:`serving_slot` because a pipelined wave acquires on the
+        dispatch thread and releases on the finalizer thread — the drain
+        refcount must span the whole dispatch→fence window or a swap could
+        retire a generation whose wave is still unfenced."""
         cond = self._drain_cond
         if cond is None:  # minimal test stubs: no drain bookkeeping
-            yield
             return
         iid = binding.instance.id
         with cond:
             self._inflight[iid] = self._inflight.get(iid, 0) + 1
+
+    def release_slot(self, binding: Binding) -> None:
+        cond = self._drain_cond
+        if cond is None:
+            return
+        iid = binding.instance.id
+        with cond:
+            n = self._inflight.get(iid, 1) - 1
+            if n <= 0:
+                self._inflight.pop(iid, None)
+            else:
+                self._inflight[iid] = n
+            cond.notify_all()
+
+    @contextlib.contextmanager
+    def serving_slot(self, binding: Binding):
+        self.acquire_slot(binding)
         try:
             yield
         finally:
-            with cond:
-                n = self._inflight.get(iid, 1) - 1
-                if n <= 0:
-                    self._inflight.pop(iid, None)
-                else:
-                    self._inflight[iid] = n
-                cond.notify_all()
+            self.release_slot(binding)
 
     def inflight_snapshot(self) -> dict[str, int]:
         """Per-generation in-flight request counts — the drain surface the
@@ -302,8 +325,15 @@ class DeployedEngine:
         flipped under it)."""
         binding = self.load_binding(instance, role="canary")
         with self._lock:
+            replaced = self._canary_binding
             self._canary_binding = binding
             self._canary_fraction = fraction
+        if replaced is not None:
+            from predictionio_tpu.parallel import device_cache
+
+            device_cache.invalidate_model_caches(
+                replaced.models, "canary_flip"
+            )
 
     def promote_canary(self) -> EngineInstance:
         """Atomic in-memory flip: the canary becomes live in one lock
@@ -324,8 +354,15 @@ class DeployedEngine:
 
     def clear_canary(self) -> None:
         with self._lock:
+            dropped = self._canary_binding
             self._canary_binding = None
             self._canary_fraction = 0.0
+        if dropped is not None:
+            from predictionio_tpu.parallel import device_cache
+
+            device_cache.invalidate_model_caches(
+                dropped.models, "canary_flip"
+            )
 
     def verify_and_swap(self, instance: EngineInstance) -> None:
         """The gated /reload path: checksum + sanity-verify the candidate,
@@ -413,6 +450,53 @@ class DeployedEngine:
             for i, q in enumerate(supplemented)
         ]
 
+    def dispatch_batch_bound(
+        self, binding: Binding, queries: list[Any]
+    ) -> Callable[[], list[tuple[Any, Any]]] | None:
+        """The ASYNC half of :meth:`predict_batch_bound`: run supplement +
+        each algorithm's ``dispatch_batch`` (host gather, h2d, async device
+        dispatch — NO blocking) and return a finalize callable that fences,
+        reads back, and serves.  Returns None — caller falls back to the
+        synchronous path — when any algorithm lacks ``dispatch_batch`` or
+        declines the shape, or when a fault plan is active (chaos plans
+        exercise the battle-tested sync seams: canary.predict, bisection)."""
+        if faults.ACTIVE is not None:
+            return None
+        # check EVERY algorithm supports async dispatch before dispatching
+        # ANY: a mixed engine must not pay gather+h2d+kernel for algorithm
+        # 1 only to discard it when algorithm 2 turns out to be sync-only
+        # (duplicate device work AND double-counted transfer metrics)
+        dispatches = [
+            getattr(a, "dispatch_batch", None) for a in binding.algorithms
+        ]
+        if any(d is None for d in dispatches):
+            return None
+        serving = binding.serving
+        with device_obs.wave_stage("host_gather"):
+            supplemented = [serving.supplement(q) for q in queries]
+        finalizers: list[Callable[[], list[tuple[int, Any]]]] = []
+        for dispatch, m in zip(dispatches, binding.models):
+            fin = dispatch(m, list(enumerate(supplemented)))
+            if fin is None:
+                # shape off this algorithm's async menu; the (possibly)
+                # already-dispatched sibling work is simply discarded
+                return None
+            finalizers.append(fin)
+
+        def finalize() -> list[tuple[Any, Any]]:
+            per_algo: list[list[Any]] = []
+            for fin in finalizers:
+                by_idx = dict(fin())
+                per_algo.append(
+                    [by_idx[i] for i in range(len(supplemented))]
+                )
+            return [
+                (q, serving.serve(q, [col[i] for col in per_algo]))
+                for i, q in enumerate(supplemented)
+            ]
+
+        return finalize
+
 
 # The engine-params JSON shape stored on EngineInstance rows round-trips
 # through params_from_json; reconstructing needs the name-keyed dicts.
@@ -459,6 +543,10 @@ def create_prediction_server_app(
     #: default per-request time budget in seconds, overridable per request
     #: via the X-Pio-Deadline header (PIO_DEFAULT_DEADLINE_S)
     default_deadline_s: float | None = None,
+    #: dispatched-but-unfenced waves the MicroBatcher may run ahead of the
+    #: finalize fence (PIO_PIPELINE_DEPTH); 0 = pipelining off (waves
+    #: finalize inline on the worker, the pre-PR-13 serial behavior)
+    pipeline_depth: int | None = None,
     #: closed-loop model lifecycle (docs/robustness.md#model-lifecycle):
     #: None = env-driven (PIO_LIFECYCLE=1), True/False = explicit; a
     #: pre-built LifecycleController may be passed for tests
@@ -479,6 +567,8 @@ def create_prediction_server_app(
         max_inflight = int(os.environ["PIO_MAX_INFLIGHT"])
     if default_deadline_s is None and os.environ.get("PIO_DEFAULT_DEADLINE_S"):
         default_deadline_s = float(os.environ["PIO_DEFAULT_DEADLINE_S"])
+    if pipeline_depth is None:
+        pipeline_depth = int(os.environ.get("PIO_PIPELINE_DEPTH", "2"))
     #: the front ends read these (httpd.observe_request / aio): deadline
     #: admission + binding, and the in-flight shed gate
     app.default_deadline_s = default_deadline_s
@@ -705,7 +795,10 @@ def create_prediction_server_app(
         return resp
 
     if use_microbatch:
-        from predictionio_tpu.server.microbatch import MicroBatcher
+        from predictionio_tpu.server.microbatch import (
+            MicroBatcher,
+            PendingWave,
+        )
 
         def _postprocess(payload, query, prediction):
             """Render + plugins + feedback — the blocking tail, on the
@@ -755,18 +848,26 @@ def create_prediction_server_app(
                 out[i] = ("pred", (q, pred))
 
         def _serve_wave(payloads):
-            """Whole wave on the worker thread: extract + vectorized predict
-            + render/plugins/feedback.  Returns per item one of
-            ("ok", rendered, degraded, route) | ("bad", err, (), route) ->
-            400 | ("err", err, (), route) -> 500, where ``route`` is the
-            ``(engine instance id, variant label)`` that answered — the
-            canary split partitions the wave per binding, each partition
-            serving whole against its own captured generation.  A poison
-            query degrades only itself, never the rest of the wave, and a
-            plugin/feedback failure on one item never re-runs prediction
-            for the others.  ``degraded`` carries wave-level fallback
-            reasons (an engine that fell back to model-only serving
-            mid-wave marks every answer it produced under that fallback)."""
+            """One wave, split at the fence (docs/performance.md).
+
+            The DISPATCH half runs here on the worker thread: extract,
+            canary partition, entity gather + h2d + async device dispatch
+            per binding partition (``dispatch_batch_bound``) — nothing
+            blocks, so the worker is free to dispatch wave N+1 the moment
+            this returns.  The FINALIZE half rides the returned
+            :class:`PendingWave` onto the MicroBatcher's finalizer thread:
+            fence + d2h + serve + render/plugins/feedback.  Per item the
+            final result is one of ("ok", rendered, degraded, route) |
+            ("bad", err, (), route) -> 400 | ("err", err, (), route) ->
+            500, where ``route`` is the ``(engine instance id, variant
+            label)`` that answered — the canary split partitions the wave
+            per binding, each partition serving whole against its own
+            captured generation (slots held from dispatch to fence, so a
+            swap cannot retire a generation with an unfenced wave).  A
+            partition whose engines lack async dispatch (or whose dispatch
+            fails) computes synchronously in the finalize half — still off
+            the worker's critical path — with the bisection fault
+            isolation unchanged: a poison query degrades only itself."""
             live_b = deployed.live_binding()
             canary_b, fraction = deployed.canary_split()
             bindings: list[Any] = []
@@ -781,6 +882,7 @@ def create_prediction_server_app(
                 (b.instance.id, deployed.binding_label(b)) for b in bindings
             ]
             parsed: list[tuple[str, Any]] = []
+            partitions: list[tuple[Any, list[int], Any]] = []
             with degraded_scope() as degraded:
                 for pl in payloads:
                     try:
@@ -797,30 +899,102 @@ def create_prediction_server_app(
                     ]
                     if not ok_idx:
                         continue
-                    with deployed.serving_slot(b):
-                        _predict_bisect(b, parsed, ok_idx, out)
-                for i, entry in enumerate(out):
-                    if entry[0] != "pred":
-                        continue
-                    q, pred = entry[1]
+                    deployed.acquire_slot(b)
+                    fin = None
                     try:
-                        out[i] = (
-                            "ok",
-                            _postprocess(payloads[i], q, pred),
-                            tuple(degraded),
+                        fin = deployed.dispatch_batch_bound(
+                            b, [parsed[i][1] for i in ok_idx]
                         )
-                    except Exception as e:  # plugin error: only this fails
-                        out[i] = ("err", e, ())
-            return [
-                (entry[0], entry[1], entry[2], routes[i])
-                for i, entry in enumerate(out)
-            ]
+                    except Exception:
+                        # dispatch failed before the fence: the finalize
+                        # half re-runs this partition synchronously with
+                        # bisection, which attributes the real poison
+                        log.exception(
+                            "async wave dispatch failed; partition falls "
+                            "back to the synchronous path"
+                        )
+                        fin = None
+                    partitions.append((b, ok_idx, fin))
+                degraded_pre = tuple(degraded)
+
+            def _finalize():
+                remaining = list(partitions)
+                try:
+                    with degraded_scope() as degraded:
+                        while remaining:
+                            b, ok_idx, fin = remaining[0]
+                            try:
+                                if fin is None:
+                                    _predict_bisect(b, parsed, ok_idx, out)
+                                else:
+                                    try:
+                                        results = fin()
+                                    except DeadlineExceeded:
+                                        # wave budget ran out at the fence:
+                                        # hand the wave to the solo-retry
+                                        # pass (per-item deadlines), same
+                                        # as the sync path
+                                        raise
+                                    except Exception:
+                                        log.exception(
+                                            "async wave finalize failed; "
+                                            "bisecting to isolate"
+                                        )
+                                        _predict_bisect(
+                                            b, parsed, ok_idx, out
+                                        )
+                                    else:
+                                        for i, (q, pred) in zip(
+                                            ok_idx, results
+                                        ):
+                                            out[i] = ("pred", (q, pred))
+                            finally:
+                                deployed.release_slot(b)
+                                remaining.pop(0)
+                        for i, entry in enumerate(out):
+                            if entry[0] != "pred":
+                                continue
+                            q, pred = entry[1]
+                            try:
+                                out[i] = (
+                                    "ok",
+                                    _postprocess(payloads[i], q, pred),
+                                    (),
+                                )
+                            except Exception as e:  # plugin error: only
+                                out[i] = ("err", e, ())  # this item fails
+                        deg = degraded_pre + tuple(
+                            d for d in degraded if d not in degraded_pre
+                        )
+                except BaseException:
+                    for b, _, _ in remaining:
+                        deployed.release_slot(b)
+                    raise
+                return [
+                    (
+                        entry[0],
+                        entry[1],
+                        deg if entry[0] == "ok" else (),
+                        routes[i],
+                    )
+                    for i, entry in enumerate(out)
+                ]
+
+            if all(fin is None for _, _, fin in partitions):
+                # nothing dispatched async (host-replica or sharded
+                # engines): compute inline on the worker thread — keeping
+                # the worker busy is what lets queue pressure coalesce the
+                # next wave (natural batching), so these waves must NOT
+                # ride the pipeline
+                return _finalize()
+            return PendingWave(_finalize)
 
         batcher = MicroBatcher(
             _serve_wave,
             max_batch=max_batch,
             drain_timeout_s=drain_timeout_s,
             registry=registry,
+            max_inflight_waves=pipeline_depth,
             # None -> the batcher's default bound; 0/negative -> unbounded
             **(
                 {"max_queue": max_queue if max_queue > 0 else None}

@@ -1,0 +1,29 @@
+"""PIO-JAX007 fixture: host sync inside the dispatch (pre-fence) region."""
+import jax
+
+
+def dispatch_batch(model, queries):
+    dev = model.kernel(queries)
+    dev.block_until_ready()  # blocks the worker before the fence
+    jax.block_until_ready(dev)  # same, module spelling
+    n = dev[0].item()  # per-item device->host sync pre-fence
+    host = jax.device_get(dev)  # explicit transfer pre-fence
+
+    def finalize():
+        # the fence region: syncing HERE is the design — exempt
+        dev.block_until_ready()
+        return jax.device_get(dev), n, host
+
+    return finalize
+
+
+def _dispatch_wave(wave):
+    out = jax.device_get(wave)  # the worker thread must stay non-blocking
+    return out
+
+
+def helper(model, queries):
+    # not a dispatch-phase function: fence-side syncs are fine here
+    x = model.kernel(queries)
+    x.block_until_ready()
+    return jax.device_get(x)

@@ -66,6 +66,17 @@ class TestRuleCorpus:
             ("PIO-JAX006", 17, "medium"),
         ]
 
+    def test_jax007_sync_in_dispatch_region(self):
+        """Pre-fence syncs flagged; the finalize closure (nested def) and
+        non-dispatch functions are the fence region — exempt."""
+        assert triples("jax007_dispatch_sync.py") == [
+            ("PIO-JAX007", 7, "medium"),
+            ("PIO-JAX007", 8, "medium"),
+            ("PIO-JAX007", 9, "medium"),
+            ("PIO-JAX007", 10, "medium"),
+            ("PIO-JAX007", 21, "medium"),
+        ]
+
     def test_conc001_blocking_in_async(self):
         assert triples("conc001_async.py") == [
             ("PIO-CONC001", 9, "high"),
@@ -121,6 +132,7 @@ class TestRuleCorpus:
                 "jax004_loop.py",
                 "jax005_default.py",
                 "jax006_reshard.py",
+                "jax007_dispatch_sync.py",
                 "conc001_async.py",
                 "conc002_poll.py",
                 "conc003_lock.py",

@@ -315,6 +315,29 @@ class TestMembership:
         finally:
             stub.shutdown()
 
+    def test_ready_probe_closes_an_open_breaker(self):
+        """A revived replica whose breaker is still OPEN (reset window not
+        yet elapsed) must become routable on the first successful /readyz
+        probe: 'a replica that answers ready IS ready' holds for
+        routable(), not just healthy — the chaos rejoin phase on a slow
+        box caught exactly this gap."""
+        stub = StubReplica("a")
+        try:
+            fleet = FleetState(
+                [stub.url], registry=MetricsRegistry(), eject_after=2,
+                breaker_reset_s=3600.0,  # a window nobody waits out
+            )
+            rep = fleet.replicas()[0]
+            for _ in range(5):
+                rep.breaker.record_failure()
+            assert rep.breaker.state == "open"
+            assert not fleet.routable()
+            assert fleet.probe_once()[stub.url] is True
+            assert rep.breaker.state == "closed"
+            assert fleet.routable()
+        finally:
+            stub.shutdown()
+
     def test_unreachable_replica_is_ejected(self):
         fleet = FleetState(
             ["http://127.0.0.1:1"], registry=MetricsRegistry(), eject_after=1
