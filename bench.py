@@ -673,6 +673,19 @@ print("metrics " + render_json_line(REGISTRY, [
 import json as _json
 print("hotpath " + _json.dumps(app.hotpath.snapshot()),
       file=sys.stderr, flush=True)
+# the watch loop's verdict on the run: tick the default alert pack once
+# over everything the load just metered — a healthy bench must show ZERO
+# firing alerts (a firing one here means the default thresholds would
+# have paged on this very run)
+if getattr(app, "alerts", None) is not None:
+    app.alerts.tick()
+    snap = app.alerts.snapshot()
+    print("alerts " + _json.dumps({
+        "firing": snap["firing"], "pending": snap["pending"],
+        "rules": len(snap["rules"]),
+        "firing_rules": sorted({a["rule"] for a in snap["alerts"]
+                                if a["state"] == "firing"}),
+    }), file=sys.stderr, flush=True)
 server.shutdown()
 """
 
@@ -919,6 +932,11 @@ def serving_p50_concurrent(model, num_users, clients=32, per_client=40):
 
                     for ln in render_hotpath_text(hotpath).splitlines():
                         log("# serving_hotpath " + ln)
+                elif line.startswith("alerts "):
+                    # the default alert pack's verdict on this very run —
+                    # a firing rule here means the thresholds would have
+                    # paged on the bench load (informational, ungated)
+                    log("# serving_alerts " + line[len("alerts "):].strip())
         except Exception:
             srv.kill()
         return med["p50_ms"], med["p99_ms"], hist, hotpath

@@ -14,7 +14,11 @@ nothing consumed it; this package is the consumer.  Three pieces:
   the fleet-aggregated ``/capacity.json``;
 - :mod:`~predictionio_tpu.fleet.autoscaler` — the controller loop that
   closes the capacity loop: scrape → aggregate → hysteresis/cooldown →
-  spawn or drain replica processes through the ``pio deploy`` machinery.
+  spawn or drain replica processes through the ``pio deploy`` machinery;
+- :mod:`~predictionio_tpu.fleet.federation` — fleet-wide telemetry
+  fan-in: the router's federated ``/metrics`` (every replica's families
+  merged with a ``replica`` label) and fleet ``/alerts.json``, so one
+  scrape watches the whole fleet.
 
 See docs/fleet.md.
 """
@@ -23,6 +27,11 @@ from predictionio_tpu.fleet.autoscaler import (
     Autoscaler,
     AutoscalerPolicy,
     LocalProcessSpawner,
+)
+from predictionio_tpu.fleet.federation import (
+    federated_alerts,
+    federated_metrics_text,
+    scrape_replicas,
 )
 from predictionio_tpu.fleet.membership import (
     FleetState,
@@ -38,5 +47,8 @@ __all__ = [
     "LocalProcessSpawner",
     "Replica",
     "create_router_app",
+    "federated_alerts",
+    "federated_metrics_text",
     "fleet_capacity",
+    "scrape_replicas",
 ]

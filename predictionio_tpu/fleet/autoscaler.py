@@ -239,11 +239,16 @@ class Autoscaler:
         policy: AutoscalerPolicy | None = None,
         registry: MetricsRegistry | None = None,
         clock: Callable[[], float] = time.monotonic,
+        alerts: Any | None = None,
     ):
         self.fleet = fleet
         self.spawner = spawner
         self.policy = policy or AutoscalerPolicy()
         self._clock = clock
+        #: an AlertEvaluator to narrate into: every scale action is
+        #: recorded as a synthetic resolved-alert event, so an incident
+        #: timeline read hours later explains WHY capacity changed
+        self.alerts = alerts
         self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
         self._wake = threading.Event()
@@ -299,6 +304,20 @@ class Autoscaler:
         with self._lock:
             self._last_event = event
         log.info("autoscaler %s", kind, extra=detail)
+        if self.alerts is not None:
+            try:
+                self.alerts.note_event(
+                    f"autoscaler_{kind}",
+                    f"autoscaler {kind}: "
+                    + " ".join(f"{k}={v}" for k, v in sorted(detail.items())),
+                    severity=(
+                        "warning" if kind == "spawn_failed" else "info"
+                    ),
+                    key=str(detail.get("replica") or ""),
+                    **detail,
+                )
+            except Exception:
+                log.exception("autoscaler alert-event note failed")
 
     # -- the loop ------------------------------------------------------------
 

@@ -23,10 +23,15 @@ Proxies ``POST /queries.json`` with:
   shows the extra lane), and ``X-Pio-Deadline`` decremented by the budget
   already spent.  The answering replica is echoed in ``X-Pio-Replica``.
 
-The router also serves ``GET /fleet.json`` (the membership registry) and
-a fleet-aggregated ``GET /capacity.json`` (sum max-QPS, min headroom,
-fleet recommended replicas) so ``pio capacity --url <router>`` and
-``pio status --url <router>`` read the whole fleet in one scrape.
+The router also serves ``GET /fleet.json`` (the membership registry), a
+fleet-aggregated ``GET /capacity.json`` (sum max-QPS, min headroom, fleet
+recommended replicas), a **federated** ``GET /metrics`` (every replica's
+families merged with a ``replica`` label — fleet/federation.py; pass
+``?local=1`` for the router's own process registry), and a fleet
+``GET /alerts.json`` (every replica's firing/pending alerts replica-tagged
+next to the router's own) so ``pio capacity --url <router>``,
+``pio status --url <router>``, and one Prometheus scrape read the whole
+fleet.
 """
 
 from __future__ import annotations
@@ -163,8 +168,24 @@ def create_router_app(
     retry_budget: RetryBudget | None = None,
     autoscaler: Any | None = None,
     on_stop: Any | None = None,
+    alerts: Any | None = None,
+    incidents: Any | None = None,
 ) -> HTTPApp:
-    """Build the router HTTPApp over a :class:`FleetState`."""
+    """Build the router HTTPApp over a :class:`FleetState`.
+
+    ``alerts`` (an AlertEvaluator over the router's registry — its default
+    breaker rule watches the per-replica breakers) and ``incidents`` ride
+    onto the observability surface; the federated ``/alerts.json`` always
+    aggregates the replicas' evaluators, folding the router's own local
+    snapshot in when one is attached."""
+    from predictionio_tpu.fleet.federation import (
+        FederationCache,
+        federated_alerts,
+        federated_metrics_text,
+        scrape_replicas,
+    )
+    from predictionio_tpu.obs.http import PROMETHEUS_CONTENT_TYPE
+
     app = HTTPApp("router")
     app.default_deadline_s = default_deadline_s
     if max_inflight is not None:
@@ -325,7 +346,58 @@ def create_router_app(
 
     # -- fleet surfaces ------------------------------------------------------
     # registered BEFORE add_observability_routes so the fleet-aggregated
-    # /capacity.json wins over the process-local one (first match routes)
+    # /capacity.json, /metrics, and /alerts.json win over the
+    # process-local ones (first match routes)
+
+    fed_cache = FederationCache()
+
+    @app.route("GET", "/metrics")
+    def federated_metrics(req: Request) -> Response:
+        """The federated exposition: one scrape sees the fleet.  The
+        process-local registry remains reachable via ``?local=1`` (and its
+        families are folded into the federation as replica="router")."""
+        if not _authorized(req):
+            return error_response(401, "Invalid accessKey.")
+        if req.query.get("local") in ("1", "true"):
+            reg.history.sample(reg)
+            return Response(
+                200,
+                reg.render_prometheus(),
+                content_type=PROMETHEUS_CONTENT_TYPE,
+            )
+
+        def build() -> str:
+            bodies, errors = scrape_replicas(fleet, "/metrics.json")
+            return federated_metrics_text(
+                bodies, errors, local_registry=reg, local_label="router"
+            )
+
+        return Response(
+            200,
+            fed_cache.get("metrics", build),
+            content_type=PROMETHEUS_CONTENT_TYPE,
+        )
+
+    @app.route("GET", "/alerts\\.json")
+    def federated_alerts_json(req: Request) -> Response:
+        """Every replica's alert state, replica-tagged, in one body (the
+        `pio status --url <router>` fold and the dashboard's fleet Alerts
+        panel read this)."""
+        if not _authorized(req):
+            return error_response(401, "Invalid accessKey.")
+
+        def build() -> dict:
+            bodies, errors = scrape_replicas(fleet, "/alerts.json")
+            return federated_alerts(
+                bodies,
+                errors,
+                local_snapshot=(
+                    alerts.snapshot() if alerts is not None else None
+                ),
+                local_label="router",
+            )
+
+        return json_response(200, fed_cache.get("alerts", build))
 
     @app.route("GET", "/fleet\\.json")
     def fleet_json(req: Request) -> Response:
@@ -393,5 +465,7 @@ def create_router_app(
         reg,
         access_key=access_key,
         readiness={"replicas_routable": _replicas_routable},
+        alerts=alerts,
+        incidents=incidents,
     )
     return app

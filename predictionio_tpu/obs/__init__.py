@@ -9,11 +9,20 @@ ring (logging.py), a flight recorder for the slowest/errored requests
 monitoring — prediction log, feedback joins, drift detection (quality.py) —
 device-efficiency attribution — XLA cost/roofline capture, recompile-storm
 detection, wave-timeline splits, the bench perf-regression gate (device.py)
-— HTTP exposition for all of it (http.py), and a sniffer plugin proving the
-plugin seams can consume the registry (plugin.py).  Dependency-free; the
-process-global default registry is ``REGISTRY``.
+— HTTP exposition for all of it (http.py), a sniffer plugin proving the
+plugin seams can consume the registry (plugin.py), and the watch loop that
+turns it all into autonomous detection: a declarative alert rules engine
+(alerts.py) whose firing transitions snapshot forensic incident bundles to
+disk before the bounded rings rotate the evidence away (incident.py).
+Dependency-free; the process-global default registry is ``REGISTRY``.
 """
 
+from predictionio_tpu.obs.alerts import (
+    AlertEvaluator,
+    AlertRule,
+    default_rule_pack,
+    resolve_rules,
+)
 from predictionio_tpu.obs.device import (
     DEVICE_EFFICIENCY,
     RECOMPILES,
@@ -28,6 +37,7 @@ from predictionio_tpu.obs.device import (
     wave_timeline,
 )
 from predictionio_tpu.obs.flight import FLIGHT, FlightRecorder, annotate
+from predictionio_tpu.obs.incident import IncidentRecorder, load_bundle
 from predictionio_tpu.obs.logging import (
     REQUEST_ID_HEADER,
     JsonLineFormatter,
@@ -72,11 +82,14 @@ from predictionio_tpu.obs.tracing import (
 )
 
 __all__ = [
+    "AlertEvaluator",
+    "AlertRule",
     "DEVICE_EFFICIENCY",
     "DevicePeaks",
     "EfficiencyTracker",
     "FLIGHT",
     "FlightRecorder",
+    "IncidentRecorder",
     "JsonLineFormatter",
     "LATENCY_BUCKETS",
     "LogRing",
@@ -105,6 +118,9 @@ __all__ = [
     "current_span",
     "default_quality",
     "default_registry",
+    "default_rule_pack",
+    "load_bundle",
+    "resolve_rules",
     "device_peaks",
     "device_snapshot",
     "jit_cost_analysis",

@@ -18,6 +18,10 @@ onto a server:
   GET  /efficiency.json     device efficiency: achieved-vs-peak roofline per
                             jitted entry point, recompile accounting (and
                             any active recompile storm), transfer tallies
+  GET  /alerts.json         the alert evaluator's live state: firing/pending
+                            instances, recent transitions, the rule set
+  GET  /incidents.json      recorded incident bundles (newest first)
+  GET  /incidents/<id>.json one full bundle (replayable by pio trace --file)
   GET  /healthz             liveness — ALWAYS ungated (load balancers carry
                             no keys); advisory SLO status rides along
   GET  /readyz              readiness checks (model loaded, stores up, ...)
@@ -73,6 +77,8 @@ _OBS_PATHS = frozenset(
         "/hotpath.json",
         "/capacity.json",
         "/fleet.json",
+        "/alerts.json",
+        "/incidents.json",
         "/healthz",
         "/readyz",
         "/slo.json",
@@ -81,7 +87,11 @@ _OBS_PATHS = frozenset(
 
 
 def is_observability_path(path: str) -> bool:
-    return path in _OBS_PATHS or path.startswith("/debug/")
+    return (
+        path in _OBS_PATHS
+        or path.startswith("/debug/")
+        or path.startswith("/incidents/")
+    )
 
 
 def record_request_outcome(app, req, resp, duration_s: float, span) -> None:
@@ -141,6 +151,8 @@ def add_observability_routes(
     debug_routes: bool = True,
     quality: Any | None = None,
     hotpath: Any | None = None,
+    alerts: Any | None = None,
+    incidents: Any | None = None,
 ):
     """The full observability surface: metrics + logs + flight + profiler +
     health.  Installs ``app.slo`` / ``app.flight`` / ``app.readiness`` so
@@ -159,6 +171,15 @@ def add_observability_routes(
     ``quality`` (a :class:`~predictionio_tpu.obs.quality.QualityMonitor`)
     installs ``app.quality`` and — on debug-route servers — serves its
     snapshot at ``GET /quality.json``, gated like the other debug routes.
+
+    ``alerts`` (an :class:`~predictionio_tpu.obs.alerts.AlertEvaluator`)
+    installs ``app.alerts`` and — on debug-route servers — serves its live
+    state at ``GET /alerts.json``; ``incidents`` (an
+    :class:`~predictionio_tpu.obs.incident.IncidentRecorder`) installs
+    ``app.incidents`` with ``GET /incidents.json`` (the listing) and
+    ``GET /incidents/<id>.json`` (one full bundle).  Both are debug-gated
+    like the flight recorder: alert state and forensic bundles describe
+    the serving program and its failures.
 
     ``hotpath`` (a :class:`~predictionio_tpu.obs.hotpath.HotPathTracker`)
     installs ``app.hotpath``.  Debug-route servers serve the solo-path
@@ -190,6 +211,10 @@ def add_observability_routes(
         app.quality = quality
     if hotpath is not None:
         app.hotpath = hotpath
+    if alerts is not None:
+        app.alerts = alerts
+    if incidents is not None:
+        app.incidents = incidents
     ring = get_log_ring()
 
     original_route = app.route
@@ -296,6 +321,41 @@ def add_observability_routes(
         @route("GET", "/quality\\.json")
         def quality_json(req: Request) -> Response:
             return json_response(200, app.quality.snapshot())
+
+    # -- alert engine + incident recorder ------------------------------------
+    # the watch loop's surfaces: live firing/pending state, and the
+    # forensic bundles recorded on firing transitions.  Debug-gated like
+    # the flight recorder — alert keys and bundles name breakers, routes,
+    # and error bodies.
+    if alerts is not None:
+
+        @route("GET", "/alerts\\.json")
+        def alerts_json(req: Request) -> Response:
+            return json_response(200, app.alerts.snapshot())
+
+    if incidents is not None:
+
+        @route("GET", "/incidents\\.json")
+        def incidents_json(req: Request) -> Response:
+            return json_response(200, app.incidents.snapshot())
+
+        @route("GET", "/incidents/(?P<iid>[^/]+)\\.json")
+        def incident_bundle(req: Request) -> Response:
+            path = app.incidents.get_path(req.params["iid"])
+            if path is None:
+                return json_response(
+                    404, {"message": f"no incident {req.params['iid']!r}"}
+                )
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    body = f.read()
+            except OSError as e:
+                return json_response(
+                    404, {"message": f"bundle unreadable: {e}"}
+                )
+            return Response(
+                200, body, content_type="application/json; charset=utf-8"
+            )
 
     # -- device efficiency ---------------------------------------------------
     # debug-gated like the flight recorder: per-fn cost tables and storm

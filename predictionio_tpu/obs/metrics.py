@@ -236,6 +236,23 @@ class MetricFamily:
             return sorted(self._children.items())
 
 
+def history_depth_from_env(default: int = 60) -> int:
+    """``PIO_METRICS_HISTORY_DEPTH`` (default 60) — how many scrape-cadence
+    samples each series ring retains.  Deeper rings buy longer sparkline /
+    incident-bundle trends at ``depth × series-cardinality`` floats of
+    memory; a malformed value falls back to the default rather than
+    killing server startup over a typo."""
+    import os
+
+    raw = os.environ.get("PIO_METRICS_HISTORY_DEPTH")
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
 class MetricsHistory:
     """Bounded per-series history ring, sampled on scrape.
 
@@ -245,10 +262,15 @@ class MetricsHistory:
     time-series backend.  ``sample`` is called by the ``/metrics``(.json)
     scrape handlers and by the dashboard render, so the ring advances at
     scrape cadence and memory stays ``depth × series-cardinality`` (series
-    cardinality is already bounded upstream by the label guards).
+    cardinality is already bounded upstream by the label guards).  Depth
+    comes from ``PIO_METRICS_HISTORY_DEPTH`` unless passed explicitly; the
+    rings are folded into incident bundles (obs/incident.py) so a
+    post-mortem sees the pre-incident trend, not just the moment of death.
     """
 
-    def __init__(self, depth: int = 60):
+    def __init__(self, depth: int | None = None):
+        if depth is None:
+            depth = history_depth_from_env()
         self.depth = max(depth, 2)
         self._lock = threading.Lock()
         self._series: dict[tuple[str, tuple[str, ...]], deque[float]] = {}
@@ -286,6 +308,21 @@ class MetricsHistory:
                 for (n, lv), dq in self._series.items()
                 if n == name
             )
+
+    def snapshot(self) -> dict[str, Any]:
+        """Every ring, JSON-shaped — the incident bundle's ``history``
+        section (oldest sample first per series)."""
+        with self._lock:
+            items = sorted(
+                (name, lv, list(dq))
+                for (name, lv), dq in self._series.items()
+            )
+        out: dict[str, Any] = {"depth": self.depth, "series": {}}
+        for name, lv, values in items:
+            out["series"].setdefault(name, []).append(
+                {"labels": list(lv), "values": values}
+            )
+        return out
 
 
 class MetricsRegistry:

@@ -322,7 +322,18 @@ class AsyncAppServer:
                 self._loop.close()
                 self._stopped.set()
 
+    def _start_app_daemons(self) -> None:
+        """Per-app daemons (the alert evaluator) start when the app starts
+        SERVING, mirroring httpd.AppServer — app construction stays
+        thread-free."""
+        alerts = getattr(self.app, "alerts", None)
+        if alerts is not None and getattr(
+            self.app, "alerts_autostart", False
+        ):
+            alerts.start()
+
     def start_background(self) -> "AsyncAppServer":
+        self._start_app_daemons()
         self._startup_error: BaseException | None = None
         self._thread = threading.Thread(
             target=self._run_loop, name=f"{self.app.name}-aio", daemon=True
@@ -337,6 +348,7 @@ class AsyncAppServer:
         return self
 
     def serve_forever(self) -> None:
+        self._start_app_daemons()
         self._run_loop()
 
     def shutdown(self) -> None:
@@ -360,6 +372,9 @@ class AsyncAppServer:
         batcher = getattr(self.app, "microbatcher", None)
         if batcher is not None:
             batcher.close()
+        alerts = getattr(self.app, "alerts", None)
+        if alerts is not None:
+            alerts.stop()
         if self._thread is not None:
             self._thread.join(timeout=5)
         else:

@@ -470,6 +470,104 @@ def _esc_num(v) -> str:
     return f"{v:g}" if isinstance(v, (int, float)) else "n/a"
 
 
+def _alerts_html(
+    app: HTTPApp, fleet_url: str | None = None, access_key: str | None = None
+) -> str:
+    """Alerts panel: the evaluator's firing/pending table (age + rule +
+    value, with links to the matching incident bundle and the assembled
+    ``/trace/<id>`` waterfall where an exemplar exists) and the recorded
+    Incidents list.  With a fleet router configured, the local snapshot is
+    swapped for the router's federated /alerts.json so the panel shows the
+    whole fleet replica-tagged."""
+    key_q = f"?accessKey={quote(access_key)}" if access_key else ""
+    evaluator = getattr(app, "alerts", None)
+    snap: dict = {}
+    source = "local"
+    if fleet_url:
+        import urllib.request
+
+        headers = {}
+        if access_key:
+            headers["Authorization"] = f"Bearer {access_key}"
+        try:
+            req = urllib.request.Request(
+                fleet_url.rstrip("/") + "/alerts.json", headers=headers
+            )
+            with urllib.request.urlopen(req, timeout=3.0) as r:
+                snap = json.loads(r.read().decode("utf-8"))
+            source = f"fleet router {fleet_url}"
+        except Exception as e:
+            snap = {}
+            source = f"router alerts unreachable ({e}); local state below"
+    if not snap and evaluator is not None:
+        snap = evaluator.snapshot()
+    recorder = getattr(app, "incidents", None)
+    incidents = recorder.list() if recorder is not None else []
+    by_rule = {}
+    for inc in incidents:
+        by_rule.setdefault(inc.get("rule"), inc)
+    rows = []
+    for a in snap.get("alerts", []):
+        inc = by_rule.get(a.get("rule"))
+        inc_cell = (
+            f"<a href='/incidents/{quote(str(inc.get('id')))}.json{key_q}'>"
+            f"{html.escape(str(inc.get('id')))}</a>"
+            if inc and inc.get("id")
+            else ""
+        )
+        tid = (inc or {}).get("exemplar_trace_id") or ""
+        trace_cell = (
+            f"<a href='/trace/{quote(str(tid))}{key_q}'>{html.escape(str(tid))}</a>"
+            if tid
+            else ""
+        )
+        age = a.get("age_s")
+        rows.append(
+            f"<tr><td><b>{html.escape(str(a.get('state', '')).upper())}</b></td>"
+            f"<td>{html.escape(str(a.get('rule')))}</td>"
+            f"<td>{html.escape(str(a.get('key') or ''))}</td>"
+            f"<td>{html.escape(str(a.get('replica') or ''))}</td>"
+            f"<td>{html.escape(str(a.get('value')))}</td>"
+            + (
+                f"<td>{age:.0f}s</td>"
+                if isinstance(age, (int, float))
+                else "<td></td>"
+            )
+            + f"<td>{html.escape(str(a.get('severity')))}</td>"
+            f"<td>{inc_cell}</td><td>{trace_cell}</td></tr>"
+        )
+    inc_rows = "".join(
+        f"<tr><td><a href='/incidents/{quote(str(i.get('id')))}.json{key_q}'>"
+        f"{html.escape(str(i.get('id')))}</a></td>"
+        f"<td>{html.escape(str(i.get('rule')))}</td>"
+        f"<td>{html.escape(str(i.get('severity')))}</td>"
+        f"<td>{i.get('spans', 0)}</td>"
+        f"<td>{html.escape(str(i.get('exemplar_trace_id') or ''))}</td></tr>"
+        for i in incidents[:15]
+    )
+    return (
+        f"<h2>Alerts</h2><p><b>{snap.get('firing', 0)}</b> firing, "
+        f"{snap.get('pending', 0)} pending "
+        f"({len(snap.get('rules', []) or [])} rules; source: "
+        f"{html.escape(source)})</p>"
+        + "".join(
+            f"<p><b>source error:</b> {html.escape(str(e))}</p>"
+            for e in snap.get("source_errors", [])
+        )
+        + "<table border='1'><tr><th>state</th><th>rule</th><th>key</th>"
+        "<th>replica</th><th>value</th><th>age</th><th>severity</th>"
+        "<th>incident</th><th>trace</th></tr>"
+        + "".join(rows)
+        + "</table>"
+        "<h3>Incidents</h3><table border='1'><tr><th>bundle</th>"
+        "<th>rule</th><th>severity</th><th>spans</th><th>exemplar</th></tr>"
+        + inc_rows
+        + "</table><p>replay offline: <code>pio incident show &lt;id&gt;"
+        "</code> · <code>pio trace &lt;trace-id&gt; --file "
+        "&lt;bundle.json&gt;</code></p>"
+    )
+
+
 def _profiling_html(access_key: str | None = None) -> str:
     """Profiling panel: the on-demand device profile and the continuous
     host stack sampler, side by side — one answers "what is the device
@@ -528,10 +626,32 @@ def create_dashboard_app(
         storage.evaluation_instances().get_completed()
         return True
 
+    # the dashboard runs its own watch loop over the process registry and
+    # reads the SAME incident directory the serving process writes (a
+    # co-located `pio deploy`'s bundles list here with zero config);
+    # PIO_ALERTS=0 disables it like everywhere else
+    from predictionio_tpu.obs.alerts import AlertEvaluator
+    from predictionio_tpu.obs.incident import IncidentRecorder
+
+    alerts_on = os.environ.get("PIO_ALERTS", "1").lower() not in (
+        "0", "off", "false", "no",
+    )
+    incidents = IncidentRecorder(app=app) if alerts_on else None
+    alerts = (
+        AlertEvaluator(app=app, incidents=incidents) if alerts_on else None
+    )
+
     # app-level access_key (when set) gates these; /healthz stays public
     add_observability_routes(
-        app, readiness={"metadata_store": _metadata_ready}, quality=quality
+        app,
+        readiness={"metadata_store": _metadata_ready},
+        quality=quality,
+        alerts=alerts,
+        incidents=incidents,
     )
+    # started by AppServer when the dashboard actually serves (app
+    # construction stays thread-free — the httpd.AppServer contract)
+    app.alerts_autostart = alerts is not None
 
     @app.route("GET", "/")
     def index(req: Request) -> Response:
@@ -557,6 +677,7 @@ def create_dashboard_app(
             "<table border='1'><tr><th>id</th><th>evaluation</th>"
             f"<th>started</th><th>finished</th><th>result</th></tr>{rows}"
             f"</table>{_health_html(app)}"
+            f"{_alerts_html(app, fleet_url=fleet_url, access_key=access_key)}"
             f"{_capacity_html(app)}"
             + (
                 _fleet_html(fleet_url, access_key=access_key)

@@ -431,7 +431,18 @@ class AppServer:
         self.host, self.port = self.httpd.server_address[:2]
         self._thread: threading.Thread | None = None
 
+    def _start_app_daemons(self) -> None:
+        """Per-app daemons (the alert evaluator) start when the app starts
+        SERVING — constructing an app must stay thread-free so a process
+        that builds many (tests, tooling) doesn't accumulate watchers."""
+        alerts = getattr(self.app, "alerts", None)
+        if alerts is not None and getattr(
+            self.app, "alerts_autostart", False
+        ):
+            alerts.start()
+
     def start_background(self) -> "AppServer":
+        self._start_app_daemons()
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, name=f"{self.app.name}-http", daemon=True
         )
@@ -439,6 +450,7 @@ class AppServer:
         return self
 
     def serve_forever(self) -> None:
+        self._start_app_daemons()
         self.httpd.serve_forever()
 
     def shutdown(self) -> None:
@@ -449,3 +461,6 @@ class AppServer:
         batcher = getattr(self.app, "microbatcher", None)
         if batcher is not None:
             batcher.close()
+        alerts = getattr(self.app, "alerts", None)
+        if alerts is not None:
+            alerts.stop()

@@ -555,6 +555,14 @@ def create_prediction_server_app(
     lifecycle_policy: "LifecyclePolicy | None" = None,
     #: start the controller's daemon thread (tests drive tick() directly)
     lifecycle_autostart: bool = True,
+    #: alert rules engine + black-box incident recorder (the watch loop,
+    #: docs/observability.md#alerting): None = env-driven (PIO_ALERTS,
+    #: default on); pre-built instances may be passed for tests
+    enable_alerts: bool | None = None,
+    alerts: "AlertEvaluator | None" = None,
+    incidents: "IncidentRecorder | None" = None,
+    #: start the evaluator's daemon thread (tests drive tick() directly)
+    alerts_autostart: bool = True,
 ) -> HTTPApp:
     import os
 
@@ -659,6 +667,40 @@ def create_prediction_server_app(
     # request decomposes into named host stages; /hotpath.json holds the
     # p50/p99-per-stage table at ≥95 % wall-time coverage
     hotpath = HotPathTracker(registry)
+
+    # -- the watch loop: alert rules engine + incident recorder --------------
+    # the evaluator ticks the default pack (plus PIO_ALERT_RULES) against
+    # this process's registry/SLO/breakers/drift/capacity state on the
+    # cheap CPU side; firing transitions snapshot a forensic bundle to
+    # disk before the bounded rings rotate the evidence away
+    from predictionio_tpu.obs.alerts import AlertEvaluator, WebhookSink
+    from predictionio_tpu.obs.incident import IncidentRecorder
+
+    if enable_alerts is None and alerts is None:
+        enable_alerts = os.environ.get("PIO_ALERTS", "1").lower() not in (
+            "0", "off", "false", "no",
+        )
+    if alerts is None and enable_alerts:
+        if incidents is None:
+            incidents = IncidentRecorder(registry=registry, app=app)
+        sinks = []
+        webhook = os.environ.get("PIO_ALERT_WEBHOOK")
+        if webhook:
+            sinks.append(WebhookSink(webhook, registry=registry))
+        alerts = AlertEvaluator(
+            registry=registry,
+            app=app,
+            interval_s=float(os.environ.get("PIO_ALERT_INTERVAL_S", "5")),
+            sinks=sinks,
+            incidents=incidents,
+        )
+    elif alerts is not None and incidents is not None:
+        alerts.incidents = incidents
+    if alerts is not None:
+        alerts.app = app
+    if incidents is not None:
+        incidents.app = app
+
     add_observability_routes(
         app,
         registry,
@@ -671,7 +713,15 @@ def create_prediction_server_app(
         },
         quality=quality,
         hotpath=hotpath,
+        alerts=alerts,
+        incidents=incidents,
     )
+    # the evaluator daemon starts when a server actually starts serving
+    # (AppServer/AsyncAppServer honor this flag), NOT at app construction:
+    # a process that builds many apps (tests, tooling) must not accumulate
+    # one idle watcher thread per app — sys._current_frames()-walking
+    # surfaces (the stack sampler) pay per live thread
+    app.alerts_autostart = alerts is not None and alerts_autostart
     m_latency = registry.histogram(
         "pio_request_latency_seconds",
         "Serving request latency by route and status",

@@ -197,6 +197,40 @@ def _status_remote(
                 "signatures; see docs/observability.md#device-efficiency)",
                 file=sys.stderr,
             )
+    # alert surface (404/401-tolerant like quality): every FIRING alert is
+    # an operator-actionable WARNING line, and any firing alert of
+    # severity "critical" flips the exit code — the watch loop's verdict
+    # outranks a process that merely answers its probes
+    critical_firing = False
+    al_status, alerts_body = fetch("/alerts.json")
+    if al_status == 200 and isinstance(alerts_body.get("alerts"), list):
+        report["alerts"] = {
+            "firing": alerts_body.get("firing", 0),
+            "pending": alerts_body.get("pending", 0),
+        }
+        for a in alerts_body["alerts"]:
+            if a.get("state") != "firing":
+                continue
+            where = (
+                f" on replica {a['replica']}"
+                if a.get("replica") and a["replica"] != "router"
+                else ""
+            )
+            print(
+                f"WARNING: alert {a.get('rule')}"
+                + (f"{{{a['key']}}}" if a.get("key") else "")
+                + f" firing{where} (value={a.get('value')}, "
+                f"severity={a.get('severity')}; see "
+                "docs/observability.md#alerting)",
+                file=sys.stderr,
+            )
+            if a.get("severity") == "critical":
+                critical_firing = True
+        for err in alerts_body.get("source_errors", []):
+            print(
+                f"note: alert federation source error: {err}",
+                file=sys.stderr,
+            )
     # fleet surface (404/401-tolerant): when the probed daemon is a fleet
     # router, fold the membership registry — any ejected replica is an
     # operator-actionable WARNING, and a fleet with zero healthy replicas
@@ -228,7 +262,11 @@ def _status_remote(
     alive = health_status == 200 and health.get("status") == "alive"
     return (
         0
-        if alive and ready_status == 200 and not drifting and not fleet_dead
+        if alive
+        and ready_status == 200
+        and not drifting
+        and not fleet_dead
+        and not critical_firing
         else 1
     )
 
@@ -1018,6 +1056,166 @@ def do_capacity(args) -> int:
     )
 
 
+def do_alerts(args) -> int:
+    """`pio alerts`: the watch loop's live state — firing/pending alert
+    instances, recent transitions, and the rule set.
+
+    With ``--url``, reads a running server's ``/alerts.json`` (a fleet
+    router answers with every replica's alerts, replica-tagged); without
+    it, dumps this process's evaluator state (usually empty — the
+    evaluator lives in the serving process).  Exit 1 on any firing alert
+    (one-shot mode) so scripts can gate on it.
+    """
+    firing_seen: list = []
+
+    def render_once() -> None:
+        from predictionio_tpu.obs.alerts import render_alerts_text
+
+        if args.url:
+            snap = json.loads(
+                _fetch_url(
+                    args.url.rstrip("/") + "/alerts.json",
+                    getattr(args, "access_key", None),
+                )
+            )
+        else:
+            snap = {"alerts": [], "firing": 0, "pending": 0, "rules": []}
+        firing_seen[:] = [snap.get("firing", 0)]
+        print(
+            json.dumps(snap, indent=2)
+            if args.json
+            else render_alerts_text(snap)
+        )
+
+    rc = _run_watched("pio alerts", render_once, args.watch, args.watch_count)
+    if rc != 0:
+        return rc
+    if not args.watch and firing_seen and firing_seen[0]:
+        return 1
+    return 0
+
+
+def do_incident(args) -> int:
+    """`pio incident list|show ID|export ID`: the black-box recorder's
+    forensic bundles — list them, render one (manifest + SLO/breaker
+    state + the exemplar request's waterfall, offline), or export one
+    (the raw bundle JSON, or the exemplar trace as Perfetto JSON).
+
+    Bundles come from ``--dir`` (default: the local incident directory,
+    ``PIO_INCIDENT_DIR`` / ``$PIO_HOME/incidents``) or ``--url`` (a
+    running server's ``/incidents.json`` + ``/incidents/<id>.json``).
+    """
+    from predictionio_tpu.obs.incident import (
+        default_incident_dir,
+        find_bundle,
+        list_incidents,
+        load_bundle,
+        render_incident_text,
+    )
+
+    directory = getattr(args, "dir", None) or default_incident_dir()
+    url = getattr(args, "url", None)
+
+    def load_by_id(incident_id: str) -> dict | None:
+        if url:
+            try:
+                return json.loads(
+                    _fetch_url(
+                        url.rstrip("/") + f"/incidents/{incident_id}.json",
+                        getattr(args, "access_key", None),
+                    )
+                )
+            except Exception as e:
+                print(f"fetch failed: {e}", file=sys.stderr)
+                return None
+        path = find_bundle(directory, incident_id)
+        if path is None:
+            print(
+                f"no incident {incident_id!r} under {directory} "
+                "(try `pio incident list`)",
+                file=sys.stderr,
+            )
+            return None
+        try:
+            return load_bundle(path)
+        except (OSError, ValueError) as e:
+            print(f"bundle unreadable: {e}", file=sys.stderr)
+            return None
+
+    if args.incident_command == "list":
+        if url:
+            try:
+                body = json.loads(
+                    _fetch_url(
+                        url.rstrip("/") + "/incidents.json",
+                        getattr(args, "access_key", None),
+                    )
+                )
+            except Exception as e:
+                print(f"fetch failed: {e}", file=sys.stderr)
+                return 1
+            incidents = body.get("incidents", [])
+        else:
+            incidents = list_incidents(directory)
+        if getattr(args, "json", False):
+            _print(incidents)
+            return 0
+        if not incidents:
+            print(f"no incident bundles ({url or directory})")
+            return 0
+        print(f"{len(incidents)} incident bundle(s), newest first:")
+        for i in incidents:
+            print(
+                f"  {i.get('id')}  rule={i.get('rule')}"
+                + (f"{{{i['key']}}}" if i.get("key") else "")
+                + f"  severity={i.get('severity')}  spans={i.get('spans', 0)}"
+                + (f"  ERROR: {i['error']}" if i.get("error") else "")
+            )
+        return 0
+
+    bundle = load_by_id(args.incident_id)
+    if bundle is None:
+        return 1
+    if args.incident_command == "show":
+        if getattr(args, "json", False):
+            _print(bundle)
+        else:
+            print(render_incident_text(bundle))
+        return 0
+    # export: raw bundle JSON (default) or the exemplar trace as Perfetto
+    out = getattr(args, "out", None) or "-"
+    if getattr(args, "perfetto", None):
+        from predictionio_tpu.obs.incident import bundle_timeline
+
+        tl = bundle_timeline(
+            bundle, trace_id=getattr(args, "trace_id", None)
+        )
+        if tl is None:
+            print(
+                "bundle holds no fragments for that trace "
+                f"(recorded: {bundle.get('trace_ids')})",
+                file=sys.stderr,
+            )
+            return 1
+        body = json.dumps(tl.to_chrome_trace())
+        if args.perfetto == "-":
+            print(body)
+        else:
+            Path(args.perfetto).write_text(body)
+            print(
+                f"wrote {tl.span_count} span(s) to {args.perfetto} "
+                "(open in https://ui.perfetto.dev)"
+            )
+        return 0
+    body = json.dumps(bundle, indent=2, sort_keys=True)
+    if out == "-":
+        print(body)
+    else:
+        Path(out).write_text(body)
+        print(f"wrote {bundle.get('id')} to {out}")
+    return 0
+
+
 def _render_fleet_text(body: dict) -> str:
     """Human one-screen rendering of a /fleet.json body."""
     lines = [
@@ -1109,6 +1307,14 @@ def _fleet_deploy(args) -> int:
         name=args.name,
         access_key=args.accesskey or None,
     )
+    # the router runs its own watch loop: its default breaker rule watches
+    # the per-replica breakers, and autoscaler actions land in the event
+    # ring as synthetic resolved alerts (docs/observability.md#alerting)
+    from predictionio_tpu.obs.alerts import AlertEvaluator
+    from predictionio_tpu.obs.incident import IncidentRecorder
+
+    incidents = IncidentRecorder()
+    alerts = AlertEvaluator(incidents=incidents)
     server = None
     autoscaler = None
     try:
@@ -1128,7 +1334,9 @@ def _fleet_deploy(args) -> int:
                     min_replicas=args.min_replicas or policy.min_replicas,
                     max_replicas=args.max_replicas or policy.max_replicas,
                 )
-            autoscaler = Autoscaler(fleet, spawner, policy=policy)
+            autoscaler = Autoscaler(
+                fleet, spawner, policy=policy, alerts=alerts
+            )
             autoscaler.start()
         server_ref: list = []
 
@@ -1143,7 +1351,12 @@ def _fleet_deploy(args) -> int:
             max_inflight=getattr(args, "max_inflight", None),
             autoscaler=autoscaler,
             on_stop=on_stop,
+            alerts=alerts,
+            incidents=incidents,
         )
+        alerts.app = app
+        incidents.app = app
+        alerts.start()
         server = AppServer(app, args.ip, args.port)
         server_ref.append(server)
         print(
@@ -1155,6 +1368,7 @@ def _fleet_deploy(args) -> int:
         except KeyboardInterrupt:
             pass
     finally:
+        alerts.stop()
         if autoscaler is not None:
             autoscaler.stop()
         fleet.stop()
@@ -1997,6 +2211,90 @@ def build_parser() -> argparse.ArgumentParser:
         help=argparse.SUPPRESS,  # bounded --watch iterations (tests)
     )
     cp.set_defaults(fn=do_capacity)
+
+    al = sub.add_parser(
+        "alerts",
+        description="Alert rules engine state: firing/pending instances, "
+        "recent transitions, and the rule set — from a running server's "
+        "/alerts.json (a fleet router answers fleet-wide, replica-"
+        "tagged).  One-shot mode exits 1 when anything is firing.",
+    )
+    al.add_argument(
+        "--url", help="read a running server (e.g. http://127.0.0.1:8000)"
+    )
+    al.add_argument(
+        "--json", action="store_true",
+        help="raw /alerts.json instead of the text summary",
+    )
+    al.add_argument(
+        "--access-key",
+        default=None,
+        help="access key for key-gated servers (sent as a Bearer header)",
+    )
+    al.add_argument(
+        "--watch",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="re-render every SECONDS until interrupted",
+    )
+    al.add_argument(
+        "--watch-count",
+        type=int,
+        default=None,
+        help=argparse.SUPPRESS,  # bounded --watch iterations (tests)
+    )
+    al.set_defaults(fn=do_alerts)
+
+    ic = sub.add_parser(
+        "incident",
+        help="black-box incident bundles: list/show/export",
+        description="Forensic incident bundles recorded by the alert "
+        "engine (docs/observability.md#alerting): list them, render one "
+        "offline (manifest + SLO/breaker state + the exemplar request's "
+        "waterfall), or export the raw bundle / Perfetto trace.",
+    )
+    icsub = ic.add_subparsers(dest="incident_command", required=True)
+    icl = icsub.add_parser("list", help="list recorded bundles")
+    ics = icsub.add_parser(
+        "show", help="render one bundle (incl. the offline waterfall)"
+    )
+    ics.add_argument("incident_id", help="bundle id (or unique prefix)")
+    ice = icsub.add_parser(
+        "export", help="dump one bundle (JSON, or --perfetto trace)"
+    )
+    ice.add_argument("incident_id", help="bundle id (or unique prefix)")
+    ice.add_argument(
+        "--out", default=None, help="output path (default: stdout)"
+    )
+    ice.add_argument(
+        "--perfetto",
+        metavar="OUT.json",
+        default=None,
+        help="write the exemplar trace as Chrome trace-event JSON "
+        "('-' for stdout)",
+    )
+    ice.add_argument(
+        "--trace-id",
+        default=None,
+        help="which recorded trace to export (default: the exemplar)",
+    )
+    for sp_ in (icl, ics, ice):
+        sp_.add_argument(
+            "--dir",
+            default=None,
+            help="bundle directory (default: PIO_INCIDENT_DIR or "
+            "$PIO_HOME/incidents)",
+        )
+        sp_.add_argument(
+            "--url",
+            default=None,
+            help="read a running server's /incidents.json instead of a "
+            "local directory",
+        )
+        sp_.add_argument("--access-key", default=None)
+        sp_.add_argument("--json", action="store_true")
+    ic.set_defaults(fn=do_incident)
 
     fl = sub.add_parser(
         "fleet",

@@ -428,3 +428,86 @@ def test_bundled_engine_contracts_gate():
         assert findings == [], f"{name}:\n" + "\n".join(
             f.text() for f in findings
         )
+
+
+def test_alert_modules_lint_clean_with_zero_pragmas():
+    """PR 14's watch loop — obs/alerts.py (the evaluator ticking against
+    the hot registries), obs/incident.py (the black-box recorder writing
+    under the serving process), fleet/federation.py (the router-side
+    fan-in blocking a serving thread per aggregation) — must be
+    `pio check`-clean with NO pragma suppressions and NO baseline entries:
+    a busy-wait, an un-timed fetch, or an unlocked mutation in the layer
+    that RUNS DURING INCIDENTS would fail exactly when it matters."""
+    files = [
+        PACKAGE / "obs" / "alerts.py",
+        PACKAGE / "obs" / "incident.py",
+        PACKAGE / "fleet" / "federation.py",
+    ]
+    report = analyze_paths(files, root=REPO_ROOT)
+    assert report.errors == []
+    assert report.findings == [], "\n".join(f.text() for f in report.findings)
+    assert report.pragma_suppressed == 0
+    names = {
+        "predictionio_tpu/obs/alerts.py",
+        "predictionio_tpu/obs/incident.py",
+        "predictionio_tpu/fleet/federation.py",
+    }
+    baselined = [
+        e for e in Baseline.load(BASELINE).entries if e.file in names
+    ]
+    assert baselined == []
+
+
+def test_incident_cli_smoke():
+    """Tier-1 smoke of the incident verb against the committed fixture
+    bundle: `pio incident list|show|export` all work offline, `show`
+    renders the exemplar waterfall from the recorded fragments, and
+    `pio trace --file <bundle>` assembles the same trace — the full
+    contract lives in tests/test_alerts.py."""
+    import contextlib
+    import io
+    import json
+
+    from predictionio_tpu.tools.cli import main
+
+    fdir = REPO_ROOT / "tests" / "fixtures" / "incidents"
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = main(["incident", "list", "--dir", str(fdir)])
+    assert rc == 0
+    assert "inc-fixture01-breaker-open-001" in out.getvalue()
+    assert "rule=breaker_open" in out.getvalue()
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = main(
+            ["incident", "show", "inc-fixture01", "--dir", str(fdir)]
+        )
+    assert rc == 0
+    text = out.getvalue()
+    assert "breaker_open{storage:127.0.0.1:7070}" in text
+    assert "severity=critical" in text
+    assert "storage.remote" in text  # the offline waterfall rendered
+    assert "injected fault" in text
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = main(
+            [
+                "incident", "export", "inc-fixture01",
+                "--dir", str(fdir), "--perfetto", "-",
+            ]
+        )
+    assert rc == 0
+    chrome = json.loads(out.getvalue())
+    names = {e.get("name") for e in chrome["traceEvents"]}
+    assert "storage.remote" in names
+
+    # the bundle doubles as a disttrace fragment file
+    bundle = fdir / "inc-fixture01-breaker-open-001.json"
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = main(["trace", "fixture01", "--file", str(bundle), "--json"])
+    assert rc == 0
+    assert json.loads(out.getvalue())["span_count"] == 3

@@ -231,6 +231,12 @@ class TestStorageDaemonDeathAndRevival:
 
         daemon_proc, rt, server, tmp_path, daemon_port = stack
         base = f"http://127.0.0.1:{server.port}"
+        # pin the watch loop's bundle directory into the test tmp BEFORE
+        # anything can fire (the evaluator daemon is already running)
+        app = server.app
+        assert app.alerts is not None and app.incidents is not None
+        app.incidents.directory = str(tmp_path / "incidents")
+        app.incidents.min_interval_s = 0.0
 
         # -- phase 1: healthy --------------------------------------------
         status, body, headers = _post(
@@ -292,6 +298,63 @@ class TestStorageDaemonDeathAndRevival:
             "seen_filter"
         ).value >= 6
 
+        # -- phase 2b: the outage is SELF-REPORTING ------------------------
+        # one evaluator tick (no sleeps: the daemon also runs, but the
+        # tick is driven for determinism) walks the default-pack
+        # breaker_open rule to firing and snapshots the forensic bundle
+        app.alerts.tick()
+        firing = {a["rule"]: a for a in app.alerts.firing()}
+        assert "breaker_open" in firing, app.alerts.snapshot()
+        assert firing["breaker_open"]["key"] == endpoint
+        assert firing["breaker_open"]["severity"] == "critical"
+        status, raw = _get(base + "/alerts.json")
+        assert status == 200
+        alerts_body = json.loads(raw)
+        assert alerts_body["firing"] >= 1
+        # `pio status` names the firing alert on stderr
+        assert cli_main(["status", "--url", base, "--no-quality"]) == 1
+        captured = capsys.readouterr()
+        assert "alert breaker_open" in captured.err
+        # the bundle landed on disk, with the evidence intact
+        from predictionio_tpu.obs.incident import (
+            load_bundle,
+            render_incident_text,
+        )
+
+        bundles = app.incidents.list()
+        assert any(b["rule"] == "breaker_open" for b in bundles), bundles
+        bpath = next(
+            b["path"] for b in bundles if b["rule"] == "breaker_open"
+        )
+        bundle = load_bundle(bpath)
+        assert bundle["breakers"][endpoint]["state"] == "open"
+        assert "metrics" in bundle and "history" in bundle
+        # the flight recorder's errored/slow entries and the fragment
+        # store's traces were captured before rotation
+        assert bundle["spans"], "bundle captured no trace fragments"
+        degraded_tids = [
+            e.get("trace_id")
+            for e in (bundle.get("flight") or {}).get("slowest", [])
+            + (bundle.get("flight") or {}).get("errors", [])
+            if e.get("trace_id")
+        ]
+        # `pio incident show` renders it offline...
+        text = render_incident_text(bundle)
+        assert "breaker_open" in text and endpoint in text
+        # ...and `pio trace --file <bundle>` assembles a recorded trace's
+        # waterfall offline (the degraded request's when flight kept one)
+        replay_tid = (
+            degraded_tids[0]
+            if degraded_tids and degraded_tids[0] in bundle["trace_ids"]
+            else bundle["exemplar_trace_id"]
+        )
+        assert replay_tid is not None
+        assert (
+            cli_main(["trace", str(replay_tid), "--file", bpath, "--json"])
+            == 0
+        )
+        capsys.readouterr()
+
         # -- phase 3: the daemon comes back -------------------------------
         revived = _spawn_storage_daemon(tmp_path / "root", daemon_port)
         try:
@@ -317,6 +380,14 @@ class TestStorageDaemonDeathAndRevival:
                 == degraded_before
             )
             assert _get(base + "/readyz")[0] == 200
+            # the SAME rule resolves once the dependency is back (driven
+            # tick for determinism; the daemon would do it within 5s)
+            app.alerts.tick()
+            assert app.alerts.firing() == []
+            assert (
+                app.alerts.recent_events()[0]["event"] == "resolved"
+                or app.alerts.recent_events()[0]["rule"] != "breaker_open"
+            )
             assert (
                 cli_main(["status", "--url", base, "--no-quality"]) == 0
             )
