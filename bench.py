@@ -480,15 +480,43 @@ def serving_p50_single(model, num_users, n=500):
     return lat[len(lat) // 2] * 1000
 
 
-def bench_event_store_20m(tr_u, tr_i, tr_r, num_users, num_items):
-    """Prove the sharded parquet event store at benchmark scale: bulk
-    columnar write of every train interaction as rate events, a sharded
-    scan back into columns, and one ALS iteration trained from the scanned
-    data (nnz parity asserted).  Returns JSON fields for the bench line.
+def _interned_const(n: int, value: str) -> np.ndarray:
+    """Constant object column sharing ONE Python object (``np.full`` boxes
+    n distinct copies, defeating the store's pointer fast paths)."""
+    a = np.empty(n, object)
+    a[:] = value
+    return a
+
+
+def _events_checksum(gu, gi, gr) -> int:
+    """Order-insensitive content checksum over the scanned columns — the
+    pre/post-compaction parity proof (compaction reorders rows; it must
+    never change their multiset)."""
+    h = (
+        gu.astype(np.uint64) * np.uint64(1315423911)
+        ^ gi.astype(np.uint64) * np.uint64(2654435761)
+        ^ (gr.astype(np.float64) * 2).astype(np.uint64) * np.uint64(97)
+    )
+    return int(np.bitwise_xor.reduce(h) ^ np.uint64(len(gu)))
+
+
+def bench_event_store(
+    tr_u, tr_i, tr_r, num_users, num_items, events_scale_m: float | None = None
+):
+    """Prove the sharded parquet data plane at benchmark scale: parallel
+    sharded bulk write, shard scan with dictionary-decode + projection,
+    watermarked compaction (content-checksum parity pre/post), and the
+    per-user history point read (the serving-path access pattern).
+
+    With ``events_scale_m`` unset, every train interaction becomes a rate
+    event (the BENCH_r05-comparable ``events20m_*`` lines).  With it set
+    (``--events-scale 100``), that many MILLION synthetic events stream in
+    in chunks — multiple write-hot segments per shard, which is what the
+    compactor exists to fold.
 
     This is the HBase-class role (HBEventsUtil.scala:83 rowkey layout ->
     entity-hash shard files; HBPEvents bulk scan -> iter_shards) exercised
-    at the 20M-event scale the reference runs against a server fleet.
+    at the scale the reference runs against a server fleet.
     """
     import shutil
     import tempfile
@@ -496,84 +524,201 @@ def bench_event_store_20m(tr_u, tr_i, tr_r, num_users, num_items):
     from predictionio_tpu.data.storage.base import EventFrame
     from predictionio_tpu.data.storage.parquet_backend import (
         ParquetClient,
+        ParquetLEvents,
         ParquetPEvents,
     )
+    from predictionio_tpu.obs.metrics import REGISTRY
     from predictionio_tpu.ops.als import ALSParams, train_als
 
-    n = len(tr_r)
+    synthetic = events_scale_m is not None
+    n = int(events_scale_m * 1e6) if synthetic else len(tr_r)
+    label = f"{events_scale_m:g}m" if synthetic else "20m"
     root = tempfile.mkdtemp(prefix="pio_bench_events_")
     try:
-        pe = ParquetPEvents(ParquetClient(root, n_shards=16))
+        client = ParquetClient(root, n_shards=16)
+        pe = ParquetPEvents(client)
+        le = ParquetLEvents(client)
         t0 = time.perf_counter()
         # vectorized column build: u<id>/i<id> string vocabularies once,
         # indexed per event — no per-event Python objects anywhere.
         # Properties ride the EventFrame LAZY-row contract (pre-serialized
-        # JSON strings): ratings take 21 distinct values, so the 20M
-        # documents are 21 interned strings indexed per event.
+        # JSON strings): ratings take ~20 distinct values, so the N
+        # documents are ~20 interned strings indexed per event.
         user_names = np.array([f"u{x}" for x in range(num_users)], object)
         item_names = np.array([f"i{x}" for x in range(num_items)], object)
-        rat_vals, rat_code = np.unique(tr_r, return_inverse=True)
+        if synthetic:
+            rng = np.random.default_rng(11)
+            rat_vals = np.arange(1, 11) / 2.0
+        else:
+            rat_vals, rat_code = np.unique(tr_r, return_inverse=True)
         rat_docs = np.array(
             [json.dumps({"rating": float(v)}) for v in rat_vals], object
         )
-        props = rat_docs[rat_code]
-        frame = EventFrame(
-            event=np.full(n, "rate", object),
-            entity_type=np.full(n, "user", object),
-            entity_id=user_names[tr_u],
-            target_entity_type=np.full(n, "item", object),
-            target_entity_id=item_names[tr_i],
-            event_time_ms=np.full(n, 1_700_000_000_000, np.int64),
-            properties=props,
-        )
-        build_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        pe.write(frame, app_id=1)
-        write_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        got_u, got_i, got_r, rows = [], [], [], 0
-        for _, f in pe.iter_shards(1):
-            rows += len(f)
-            # vectorized "u123" -> 123 (fixed-width U dtype, C string ops)
-            got_u.append(
-                np.char.lstrip(f.entity_id.astype(str), "u").astype(np.int32)
+
+        def build_chunk(lo: int, hi: int) -> EventFrame:
+            m = hi - lo
+            if synthetic:
+                cu = rng.integers(0, num_users, m)
+                ci = rng.integers(0, num_items, m)
+                cc = rng.integers(0, len(rat_vals), m)
+            else:
+                cu, ci, cc = tr_u[lo:hi], tr_i[lo:hi], rat_code[lo:hi]
+            return EventFrame(
+                event=_interned_const(m, "rate"),
+                entity_type=_interned_const(m, "user"),
+                entity_id=user_names[cu],
+                target_entity_type=_interned_const(m, "item"),
+                target_entity_id=item_names[ci],
+                event_time_ms=np.full(m, 1_700_000_000_000, np.int64)
+                + np.arange(lo, hi, dtype=np.int64) % 86_400_000,
+                properties=rat_docs[cc],
             )
-            got_i.append(
-                np.char.lstrip(
-                    f.target_entity_id.astype(str), "i"
-                ).astype(np.int32)
+
+        # chunked ingest: bounded host RAM at 100M rows, and >1 write-hot
+        # segment per shard so compaction folds real backlog
+        chunk = min(n, 12_500_000)
+        build_s = 0.0
+        write_s = 0.0
+        for lo in range(0, n, chunk):
+            t0 = time.perf_counter()
+            frame = build_chunk(lo, min(lo + chunk, n))
+            build_s += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            pe.write(frame, app_id=1)
+            write_s += time.perf_counter() - t0
+            del frame
+
+        from predictionio_tpu.data.storage.base import ptr_factorize
+
+        def names_to_int(col: np.ndarray, prefix: str) -> np.ndarray:
+            # "u123" -> 123.  Scans hand back dictionary-decoded columns
+            # whose rows POINT at the vocabulary, so the string parse runs
+            # once per unique name, not once per row
+            f = ptr_factorize(col)
+            if f is not None:
+                codes, uniq = f
+                vals = np.fromiter(
+                    (int(s[len(prefix):]) for s in uniq),
+                    np.int32,
+                    len(uniq),
+                )
+                return vals[codes]
+            return np.char.lstrip(col.astype(str), prefix).astype(np.int32)
+
+        def scan():
+            got_u, got_i, got_r, rows = [], [], [], 0
+            for _, f in pe.iter_shards(
+                1, columns=["entity_id", "target_entity_id", "properties"]
+            ):
+                rows += len(f)
+                got_u.append(names_to_int(f.entity_id, "u"))
+                got_i.append(names_to_int(f.target_entity_id, "i"))
+                got_r.append(f.property_column("rating"))
+            return (
+                rows,
+                np.concatenate(got_u),
+                np.concatenate(got_i),
+                np.concatenate(got_r).astype(np.float32),
             )
-            got_r.append(f.property_column("rating"))
+
+        t0 = time.perf_counter()
+        rows, gu, gi, gr = scan()
         scan_s = time.perf_counter() - t0
         assert rows == n, f"store round trip lost rows: {rows} != {n}"
-        gu = np.concatenate(got_u)
-        gi = np.concatenate(got_i)
-        gr = np.concatenate(got_r).astype(np.float32)
-        # free the per-shard copies before training: at 20M rows the frame
-        # plus shard lists hold ~GBs of host RAM, and the staging sort
-        # below slows to a crawl if the host starts swapping
-        del got_u, got_i, got_r, frame, props
-        t0 = time.perf_counter()
-        st = train_als(
-            gu, gi, gr, num_users, num_items,
-            params=ALSParams(rank=10, reg=0.01, seed=3, num_iterations=1),
-        )
-        device_sync(st.user_factors)
-        train1_s = time.perf_counter() - t0
-        assert np.isfinite(np.asarray(st.user_factors)).all()
+        checksum_pre = _events_checksum(gu, gi, gr)
+
         gb = sum(
-            f.stat().st_size for f in __import__("pathlib").Path(root).rglob("*.parquet")
+            f.stat().st_size
+            for f in __import__("pathlib").Path(root).rglob("*.parquet")
         ) / 1e9
-        log(
-            f"# event store @20M: build={build_s:.0f}s write={write_s:.0f}s "
-            f"({gb:.2f} GB parquet) shard_scan={scan_s:.0f}s "
-            f"train1_from_store={train1_s:.0f}s rows={rows}"
+        # watermarked background compaction: fold the write-hot head, then
+        # prove the scan is bit-identical (row count + content checksum)
+        t0 = time.perf_counter()
+        live = pe.compact(1)
+        compact_s = time.perf_counter() - t0
+        assert live == n, f"compaction changed row count: {live} != {n}"
+        status = pe.status(1)
+        t0 = time.perf_counter()
+        rows2, gu2, gi2, gr2 = scan()
+        scan_post_s = time.perf_counter() - t0
+        checksum_post = _events_checksum(gu2, gi2, gr2)
+        assert rows2 == n and checksum_post == checksum_pre, (
+            "post-compaction scan is not bit-identical: "
+            f"rows {rows2}!={n} or checksum {checksum_post}!={checksum_pre}"
         )
-        return {
-            "events20m_write_s": round(write_s, 1),
-            "events20m_scan_s": round(scan_s, 1),
-            "events20m_parquet_gb": round(gb, 2),
+        del gu2, gi2, gr2
+
+        # per-user history point read on the compacted store — the
+        # sequence engine's serving-path access pattern.  Bytes-read vs
+        # bytes-skipped counters prove the segment/row-group skipping.
+        def _counter(family):
+            return REGISTRY.counter(
+                family, labelnames=("kind",)
+            ).labels("entity").value
+
+        br0, bs0 = (
+            _counter("pio_eventstore_bytes_read_total"),
+            _counter("pio_eventstore_bytes_skipped_total"),
+        )
+        probes = 200
+        rng2 = np.random.default_rng(5)
+        lats = []
+        for q in rng2.integers(0, num_users, probes):
+            t0 = time.perf_counter()
+            evs = list(
+                le.find_by_entity(
+                    1, "user", f"u{q}", limit=50, reversed=True
+                )
+            )
+            lats.append(time.perf_counter() - t0)
+        lats.sort()
+        hist_p50_ms = lats[probes // 2] * 1000
+        hist_p99_ms = lats[int(probes * 0.99)] * 1000
+        br, bs = (
+            _counter("pio_eventstore_bytes_read_total") - br0,
+            _counter("pio_eventstore_bytes_skipped_total") - bs0,
+        )
+        bytes_frac = br / (br + bs) if (br + bs) else 0.0
+
+        train1_s = None
+        if not synthetic:
+            # one ALS iteration trained from the scanned columns (the
+            # PEventStore seam end to end; nnz parity asserted above)
+            t0 = time.perf_counter()
+            st = train_als(
+                gu, gi, gr, num_users, num_items,
+                params=ALSParams(rank=10, reg=0.01, seed=3, num_iterations=1),
+            )
+            device_sync(st.user_factors)
+            train1_s = time.perf_counter() - t0
+            assert np.isfinite(np.asarray(st.user_factors)).all()
+        del gu, gi, gr
+
+        log(
+            f"# event store @{label}: build={build_s:.0f}s "
+            f"write={write_s:.1f}s ({gb:.2f} GB parquet) "
+            f"shard_scan={scan_s:.1f}s compact={compact_s:.1f}s "
+            f"scan_postcompact={scan_post_s:.1f}s "
+            f"user_history p50={hist_p50_ms:.2f}ms p99={hist_p99_ms:.2f}ms "
+            f"(bytes touched {bytes_frac:.1%}) backlog="
+            f"{status['backlog_segments']} rows={rows}"
+            + (f" train1_from_store={train1_s:.0f}s" if train1_s else "")
+        )
+        out = {
+            f"events{label}_write_s": round(write_s, 1),
+            f"events{label}_scan_s": round(scan_s, 1),
+            f"events{label}_parquet_gb": round(gb, 2),
+            f"events{label}_compact_s": round(compact_s, 1),
+            f"events{label}_scan_postcompact_s": round(scan_post_s, 1),
+            "events_scale_m": round(n / 1e6, 3),
+            "events_write_mb_s": round(gb * 1000 / write_s, 1),
+            "events_scan_mb_s": round(gb * 1000 / scan_s, 1),
+            "events_user_history_p50_ms": round(hist_p50_ms, 2),
+            "events_user_history_p99_ms": round(hist_p99_ms, 2),
+            "events_history_bytes_frac": round(bytes_frac, 4),
+            "events_compaction_backlog": status["backlog_segments"],
         }
+        return out
     finally:
         shutil.rmtree(root, ignore_errors=True)
 
@@ -1545,13 +1690,17 @@ def main() -> None:
         )
 
     def sec_event_store():
-        # 20M-event store proof: the full event-data plane at benchmark
-        # scale — bulk columnar write into the sharded parquet store,
-        # entity-hash shard scan back out, and an ALS iteration trained
-        # from the scanned columns (the PEventStore seam end to end)
+        # event-data plane proof at benchmark scale — parallel sharded
+        # bulk write, dictionary-decoded shard scan, watermarked
+        # compaction with checksum parity, per-user history point reads,
+        # and (at train scale) an ALS iteration trained from the scanned
+        # columns (the PEventStore seam end to end).  ``--events-scale
+        # 100`` runs the slow 100M-row mode instead of the train arrays.
         metrics.update(
-            bench_event_store_20m(C.tr_u, C.tr_i, C.tr_r, num_users,
-                                  num_items)
+            bench_event_store(
+                C.tr_u, C.tr_i, C.tr_r, num_users, num_items,
+                events_scale_m=events_scale_m,
+            )
         )
 
     def sec_als_serving():
@@ -1645,6 +1794,15 @@ def main() -> None:
             f"achieved={gbps:.1f} GB/s "
             f"({metrics['fused_topk_hbm_utilization_frac']:.1%} of HBM "
             f"peak ~{peaks.hbm_gbps:.0f})"
+        )
+
+    # --events-scale N: run the event-store section over N MILLION
+    # synthetic rows instead of the train arrays (the slow 100M-row data-
+    # plane mode; only runs when explicitly requested)
+    events_scale_m = None
+    if "--events-scale" in sys.argv:
+        events_scale_m = float(
+            sys.argv[sys.argv.index("--events-scale") + 1]
         )
 
     # --devices N: the sharded scaling section (model-parallel serving +

@@ -210,6 +210,65 @@ class DirectWriteToPersistencePath(Rule):
             )
 
 
+#: keyword names that bound a parquet read (either prunes what is
+#: materialized): projection or predicate
+_READ_BOUND_KWARGS = frozenset({"columns", "filters", "filter"})
+
+
+@rule
+class FullTableMaterializationInStoragePath(Rule):
+    """PIO-RES004: unbounded parquet read in a storage-pathed module."""
+
+    id = "PIO-RES004"
+    severity = Severity.MEDIUM
+    summary = (
+        "full-table parquet materialization: read_table/to_table/"
+        "ParquetFile(...).read() without columns= or filters= decodes "
+        "every row group and column of the file"
+    )
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        # storage modules only: the event tier at 100M+ rows lives or
+        # dies on predicate/column pushdown (docs/data_plane.md); an
+        # unbounded read_table on a scan path silently drags the whole
+        # log through memory
+        if "storage" not in mod.rel.replace("\\", "/"):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if any(kw.arg is None for kw in node.keywords):
+                continue  # **kwargs may carry a bound; don't guess
+            kwargs = {kw.arg for kw in node.keywords}
+            if kwargs & _READ_BOUND_KWARGS:
+                continue
+            callee = resolve_call(mod, node)
+            what = None
+            if callee == "pyarrow.parquet.read_table":
+                what = "pyarrow.parquet.read_table(...)"
+            elif isinstance(node.func, ast.Attribute):
+                if node.func.attr == "to_table":
+                    what = ".to_table(...)"
+                elif (
+                    node.func.attr == "read"
+                    and isinstance(node.func.value, ast.Call)
+                    and resolve_call(mod, node.func.value)
+                    == "pyarrow.parquet.ParquetFile"
+                ):
+                    what = "pyarrow.parquet.ParquetFile(...).read()"
+            if what is None:
+                continue
+            yield self.finding(
+                mod,
+                node,
+                f"{what} without columns= or filters= materializes the "
+                "whole file; scans at event-store scale must push the "
+                "projection/predicate into the reader (pass columns= "
+                "and/or filters=/filter=, even if spelled out in full, "
+                "so the read is a deliberate bound)",
+            )
+
+
 @rule
 class SilentExceptionSwallowOnHotPath(Rule):
     """PIO-RES002: ``except Exception: pass`` inside a serving hot-path

@@ -294,6 +294,69 @@ def _manifest_part_names(raw: bytes) -> list[str]:
     return names.split(",") if names else []
 
 
+def run_concurrent(executor, thunks: Sequence) -> list:
+    """Run thunks on the executor and join them ALL, then surface the
+    first error — the fan-out idiom shared by the parquet backend's
+    per-shard segment writes and the remote fleet's per-daemon calls
+    (joining everything first keeps partial failures from orphaning
+    in-flight writes)."""
+    if len(thunks) == 1:
+        return [thunks[0]()]
+    futs = [executor.submit(t) for t in thunks]
+    out, errs = [], []
+    for f in futs:
+        try:
+            out.append(f.result())
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+    if errs:
+        raise errs[0]
+    return out
+
+
+def obj_ptrs(col: np.ndarray) -> np.ndarray | None:
+    """int64 view of an object array's PyObject pointers (read-only; the
+    caller must keep ``col`` alive while using the view).
+
+    Pointer equality implies value equality, so a pointer-level
+    factorization is a *conservative* dictionary encode: bulk columns are
+    built as ``vocabulary[codes]`` (one Python object per unique value,
+    broadcast), and hashing 8-byte pointers is ~10x cheaper than hashing
+    the strings/dicts they point to.  Distinct-but-equal objects merely
+    split a dictionary entry — never wrong, just less compact."""
+    if col.dtype != object or col.itemsize != 8 or len(col) == 0:
+        return None
+    import ctypes
+
+    buf = (ctypes.c_char * (len(col) * col.itemsize)).from_address(
+        col.ctypes.data
+    )
+    return np.frombuffer(buf, dtype=np.int64)
+
+
+def ptr_factorize(
+    col: np.ndarray, max_card_frac: float = 0.25
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """(codes int64, unique objects) by pointer identity, or None when the
+    column is mostly-distinct at the pointer level (note that
+    ``np.full(n, "x", object)`` boxes n DISTINCT objects — constant
+    columns built that way need a value-level pass)."""
+    import pandas as pd
+
+    col = np.ascontiguousarray(col)
+    ptrs = obj_ptrs(col)
+    if ptrs is None:
+        return None
+    codes, uniq_ptrs = pd.factorize(ptrs)
+    n, k = len(col), len(uniq_ptrs)
+    if k > max(int(n * max_card_frac), 64):
+        return None
+    # first-occurrence index per code: reversed scatter, last write wins
+    first = np.empty(k, np.int64)
+    first[codes[::-1]] = np.arange(n - 1, -1, -1)
+    return codes, col[first]
+
+
 def entity_shard(entity_type: str, entity_id: str, n_shards: int) -> int:
     """The HBEventsUtil.scala:83 row-key hash, reduced to a shard index.
     Every backend's scan sharding (parquet layout, SQL entity-hash scans,
@@ -305,17 +368,29 @@ def entity_shard(entity_type: str, entity_id: str, n_shards: int) -> int:
 
 
 def frame_shard_of(
-    entity_type_col: np.ndarray, entity_id_col: np.ndarray, n_shards: int
+    entity_type_col: np.ndarray,
+    entity_id_col: np.ndarray,
+    n_shards: int,
+    factorized: tuple[tuple, tuple] | None = None,
 ) -> np.ndarray:
     """Vectorized entity_shard over frame columns: md5 each UNIQUE
     (type, id) pair once (entities are ~100x fewer than events) and
     broadcast through hash-based pandas factorize codes — the one home of
-    the pair-coding arithmetic every backend's scan splitting shares."""
+    the pair-coding arithmetic every backend's scan splitting shares.
+
+    ``factorized`` lets a caller that already factorized the columns
+    (the parquet write path shares its arrow-conversion factorization)
+    skip the two hash passes: ``((tcode, utypes), (icode, uids))``."""
     import pandas as pd
 
-    tcode, utypes = pd.factorize(entity_type_col)
-    icode, uids = pd.factorize(entity_id_col)
-    inv, upairs = pd.factorize(tcode.astype(np.int64) * len(uids) + icode)
+    if factorized is not None:
+        (tcode, utypes), (icode, uids) = factorized
+    else:
+        tcode, utypes = pd.factorize(entity_type_col)
+        icode, uids = pd.factorize(entity_id_col)
+    inv, upairs = pd.factorize(
+        tcode.astype(np.int64) * len(uids) + icode
+    )
     utypes = np.asarray(utypes, object)
     uids = np.asarray(uids, object)
     shard_of_uniq = np.fromiter(
@@ -440,6 +515,40 @@ class LEvents(abc.ABC):
         channel_id: int | None = None,
         filter: EventFilter | None = None,
     ) -> Iterator[Event]: ...
+
+    def find_by_entity(
+        self,
+        app_id: int,
+        entity_type: str,
+        entity_id: str,
+        channel_id: int | None = None,
+        event_names: Sequence[str] | None = None,
+        target_entity_type: str | None = None,
+        target_entity_id: str | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        limit: int | None = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        """Per-entity history — the serving-path access pattern (sequence
+        models, business rules).  The default delegates to ``find`` with
+        an entity-pinned filter; backends with a cheaper point-read path
+        (parquet segment/row-group skipping) override."""
+        return self.find(
+            app_id,
+            channel_id,
+            EventFilter(
+                start_time=start_time,
+                until_time=until_time,
+                entity_type=entity_type,
+                entity_id=entity_id,
+                event_names=tuple(event_names) if event_names else None,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id,
+                limit=limit,
+                reversed=reversed,
+            ),
+        )
 
     def aggregate_properties(
         self,
@@ -586,8 +695,28 @@ class EventFrame:
         ("4.5") and bools coerce the way the row-wise engine loops always
         did via ``float(props[name])`` — stored event data keeps training
         identically whichever path reads it."""
-        # branch on row kind FIRST (a cheap isinstance sweep) so a lazy
-        # row late in a mostly-dict frame doesn't waste a full eager fill
+        # repetitive frames (dictionary-decoded scans, vocabulary-broadcast
+        # ingest) collapse under pointer identity: parse/coerce each UNIQUE
+        # document once and broadcast — a 20M-row rating column is ~20
+        # distinct JSON documents
+        f = ptr_factorize(self.properties)
+        if f is not None:
+            codes, uniq = f
+            k = len(uniq)
+            vals = np.empty(k, np.float64)
+            absent = np.zeros(k, bool)
+            for j, p in enumerate(uniq):
+                v = self._row_value(p, name)
+                if v is None:
+                    absent[j] = True
+                    vals[j] = 0.0
+                else:
+                    vals[j] = v
+            out = vals[codes].astype(dtype)
+            out[absent[codes]] = default
+            return out
+        # branch on row kind (a cheap isinstance sweep) so a lazy row late
+        # in a mostly-dict frame doesn't waste a full eager fill
         if any(isinstance(p, str) for p in self.properties):
             return self._lazy_property_column(name, default, dtype)
         out = np.full(len(self), default, dtype=dtype)
@@ -650,6 +779,22 @@ class EventFrame:
         mask = ~np.isnan(vals)
         out[mask] = vals[mask].astype(dtype)
         return out
+
+    @staticmethod
+    def _row_value(p, name: str) -> float | None:
+        """One row's coerced property value (None = absent/malformed) —
+        the exact semantics of the row-wise loop, applied per UNIQUE
+        document by the pointer fast path."""
+        if isinstance(p, str):
+            if not p:
+                return None
+            try:
+                d = json.loads(p)
+            except json.JSONDecodeError:
+                return None  # junk row -> no properties
+        else:
+            d = p
+        return _coerce_numeric(d.get(name) if isinstance(d, dict) else None)
 
     def _rowwise_property_column(self, name: str, out: np.ndarray) -> np.ndarray:
         """Exact per-row semantics; malformed lazy rows count as empty."""

@@ -157,6 +157,16 @@ class StorageRuntime:
         ``BREAKER`` off|on, ``BREAKER_THRESHOLD`` consecutive transport
         failures before the circuit opens, ``BREAKER_RESET_S`` open->half-
         open delay."""
+        clients = self._remote_clients(name, props)
+        return clients[0]
+
+    def _remote_clients(self, name: str, props: dict[str, str]) -> list:
+        """All clients of a remote source.  A comma-separated URL names a
+        storage FLEET: the event DAOs fan writes/scans out across the
+        daemons by entity-hash shard (shard k -> daemon k % D), scaling
+        the cheap CPU event tier horizontally (docs/data_plane.md);
+        metadata/models stay on the first daemon (single source of
+        truth)."""
         from predictionio_tpu.data.storage.remote_backend import RemoteClient
         from predictionio_tpu.resilience.retry import RetryPolicy
 
@@ -169,30 +179,39 @@ class StorageRuntime:
                         f"remote source {name} needs PIO_STORAGE_SOURCES_"
                         f"{name}_URL (e.g. http://host:7072)"
                     )
+                urls = [u.strip() for u in url.split(",") if u.strip()]
                 breaker_off = props.get("BREAKER", "on").lower() in (
                     "off",
                     "false",
                     "0",
                     "no",
                 )
-                self._clients[key] = RemoteClient(
-                    url,
-                    auth_key=props.get("AUTHKEY"),
-                    # bulk /frame scans of big apps can legitimately run
-                    # past the default; operators size this to their data
-                    timeout=float(props.get("TIMEOUT", 30.0)),
-                    verify=props.get("VERIFY", "true").lower()
-                    not in ("false", "0", "no"),
-                    retry=RetryPolicy(
-                        max_attempts=max(int(props.get("RETRIES", 2)), 1),
-                        base_backoff_s=float(
-                            props.get("RETRY_BACKOFF_S", 0.05)
+                self._clients[key] = [
+                    RemoteClient(
+                        u,
+                        auth_key=props.get("AUTHKEY"),
+                        # bulk /frame scans of big apps can legitimately
+                        # run past the default; operators size this to
+                        # their data
+                        timeout=float(props.get("TIMEOUT", 30.0)),
+                        verify=props.get("VERIFY", "true").lower()
+                        not in ("false", "0", "no"),
+                        retry=RetryPolicy(
+                            max_attempts=max(int(props.get("RETRIES", 2)), 1),
+                            base_backoff_s=float(
+                                props.get("RETRY_BACKOFF_S", 0.05)
+                            ),
                         ),
-                    ),
-                    breaker=None if breaker_off else "auto",
-                    breaker_threshold=int(props.get("BREAKER_THRESHOLD", 5)),
-                    breaker_reset_s=float(props.get("BREAKER_RESET_S", 5.0)),
-                )
+                        breaker=None if breaker_off else "auto",
+                        breaker_threshold=int(
+                            props.get("BREAKER_THRESHOLD", 5)
+                        ),
+                        breaker_reset_s=float(
+                            props.get("BREAKER_RESET_S", 5.0)
+                        ),
+                    )
+                    for u in urls
+                ]
             return self._clients[key]
 
     def _meta_dao(self, sqlite_cls, remote_cls):
@@ -291,11 +310,15 @@ class StorageRuntime:
                     )
                 elif typ == "remote":
                     from predictionio_tpu.data.storage.remote_backend import (
+                        FanoutLEvents,
                         RemoteLEvents,
                     )
 
-                    self._clients["__levents__"] = RemoteLEvents(
-                        self._remote_client(name, props)
+                    clients = self._remote_clients(name, props)
+                    self._clients["__levents__"] = (
+                        RemoteLEvents(clients[0])
+                        if len(clients) == 1
+                        else FanoutLEvents(clients)
                     )
                 else:
                     self._clients["__levents__"] = SQLiteLEvents(
@@ -318,11 +341,15 @@ class StorageRuntime:
                     )
                 elif typ == "remote":
                     from predictionio_tpu.data.storage.remote_backend import (
+                        FanoutPEvents,
                         RemotePEvents,
                     )
 
-                    self._clients["__pevents__"] = RemotePEvents(
-                        self._remote_client(name, props)
+                    clients = self._remote_clients(name, props)
+                    self._clients["__pevents__"] = (
+                        RemotePEvents(clients[0])
+                        if len(clients) == 1
+                        else FanoutPEvents(clients)
                     )
                 else:
                     self._clients["__pevents__"] = SQLitePEvents(
@@ -335,7 +362,9 @@ class StorageRuntime:
         runtime — what /readyz folds in (scoped to THIS runtime's
         endpoints, not every breaker in the process)."""
         with self._lock:
-            clients = list(self._clients.values())
+            clients = []
+            for c in self._clients.values():
+                clients.extend(c if isinstance(c, list) else [c])
         out = []
         for c in clients:
             br = getattr(c, "breaker", None)
@@ -362,7 +391,10 @@ class StorageRuntime:
 
     def close(self) -> None:
         with self._lock:
+            flat = []
             for c in self._clients.values():
+                flat.extend(c if isinstance(c, list) else [c])
+            for c in flat:
                 try:
                     c.close()
                 except Exception:

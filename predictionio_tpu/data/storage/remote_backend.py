@@ -34,6 +34,8 @@ import random
 import ssl
 import threading
 import time
+
+import numpy as np
 from datetime import datetime
 from typing import Any, Iterator, Sequence
 from urllib.parse import quote, urlencode, urlsplit
@@ -908,3 +910,344 @@ class RemotePEvents(base.PEvents):
             idempotent=True,
         )
         return d["rows"] if d.get("supported", True) else None
+
+    def status(self, app_id: int, channel_id: int | None = None) -> dict:
+        """Daemon-side event-store layout stats (segment counts, backlog,
+        watermark lag) — the ``pio eventstore status`` surface."""
+        return self.client.json(
+            "GET",
+            f"/v1/apps/{app_id}/eventstore_status",
+            params=_chan_params(channel_id),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Multi-daemon fan-out: parallel sharded ingest across a storage fleet
+# ---------------------------------------------------------------------------
+#
+# One storage daemon owns one parquet root.  To scale the (cheap, CPU-bound)
+# event tier horizontally — arXiv 2509.14920's cost split — a source may
+# name SEVERAL daemon URLs (comma-separated).  Entity-hash shard k lives on
+# daemon k % D: the same md5 family that lays out each daemon's parquet
+# shards routes rows between daemons, so an entity's whole history stays on
+# one daemon and per-entity reads touch exactly one host.  Writes partition
+# the batch by home daemon and fan out concurrently; scans fan in.
+
+
+def _fanout_pool() -> "ThreadPoolExecutor":
+    from concurrent.futures import ThreadPoolExecutor
+
+    global _FANOUT_POOL
+    with _FANOUT_POOL_LOCK:  # two first-callers must not leak a pool
+        if _FANOUT_POOL is None:
+            _FANOUT_POOL = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="pio-fanout"
+            )
+        return _FANOUT_POOL
+
+
+_FANOUT_POOL = None
+_FANOUT_POOL_LOCK = threading.Lock()
+
+
+def _run_all(calls):
+    """Run per-daemon thunks concurrently on the shared fan-out pool
+    (join-all + first-error semantics live in base.run_concurrent)."""
+    return base.run_concurrent(_fanout_pool(), calls)
+
+
+class _ShardCountCache:
+    """Per-(app, channel) n_shards memo: the value is fixed at app init,
+    so the serving-path point reads must not pay a /shards round trip to
+    daemon 0 per call."""
+
+    def __init__(self, pevents: "RemotePEvents"):
+        self._pe = pevents
+        self._cache: dict[tuple[int, int | None], int] = {}
+        self._lock = threading.Lock()
+
+    def get(self, app_id: int, channel_id: int | None) -> int:
+        key = (app_id, channel_id)
+        with self._lock:
+            n = self._cache.get(key)
+        if n is None:
+            n = self._pe.n_shards(app_id, channel_id)
+            with self._lock:
+                self._cache[key] = n
+        return n
+
+
+class FanoutLEvents(base.LEvents):
+    """Row DAO over D storage daemons, routed by entity-hash shard."""
+
+    def __init__(self, clients: Sequence[RemoteClient]):
+        self.subs = [RemoteLEvents(c) for c in clients]
+        self._pevents = [RemotePEvents(c) for c in clients]
+        self._shards = _ShardCountCache(self._pevents[0])
+
+    def _n_shards(self, app_id: int, channel_id: int | None) -> int:
+        return self._shards.get(app_id, channel_id)
+
+    def _home(
+        self, app_id: int, channel_id: int | None, entity_type: str, entity_id: str
+    ) -> "RemoteLEvents":
+        n = self._n_shards(app_id, channel_id)
+        shard = base.entity_shard(entity_type, entity_id, n)
+        return self.subs[shard % len(self.subs)]
+
+    def init(self, app_id: int, channel_id: int | None = None) -> bool:
+        return all(_run_all([
+            (lambda s=s: s.init(app_id, channel_id)) for s in self.subs
+        ]))
+
+    def remove(self, app_id: int, channel_id: int | None = None) -> bool:
+        return any(_run_all([
+            (lambda s=s: s.remove(app_id, channel_id)) for s in self.subs
+        ]))
+
+    def close(self) -> None:
+        for s in self.subs:
+            s.close()
+
+    def insert(
+        self, event: Event, app_id: int, channel_id: int | None = None
+    ) -> str:
+        return self._home(
+            app_id, channel_id, event.entity_type, event.entity_id
+        ).insert(event, app_id, channel_id)
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: int | None = None
+    ) -> list[str]:
+        if not events:
+            return []
+        n = self._n_shards(app_id, channel_id)
+        d = len(self.subs)
+        groups: dict[int, list[int]] = {}
+        for i, e in enumerate(events):
+            home = base.entity_shard(e.entity_type, e.entity_id, n) % d
+            groups.setdefault(home, []).append(i)
+        ids: list[str | None] = [None] * len(events)
+
+        def send(home: int, idx: list[int]):
+            got = self.subs[home].insert_batch(
+                [events[i] for i in idx], app_id, channel_id
+            )
+            for i, eid in zip(idx, got):
+                ids[i] = eid
+
+        _run_all([
+            (lambda h=h, ix=ix: send(h, ix)) for h, ix in groups.items()
+        ])
+        return ids  # type: ignore[return-value]
+
+    def get(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> Event | None:
+        # the id alone does not name a home daemon: probe all concurrently
+        for got in _run_all([
+            (lambda s=s: s.get(event_id, app_id, channel_id))
+            for s in self.subs
+        ]):
+            if got is not None:
+                return got
+        return None
+
+    def delete(
+        self, event_id: str, app_id: int, channel_id: int | None = None
+    ) -> bool:
+        return any(_run_all([
+            (lambda s=s: s.delete(event_id, app_id, channel_id))
+            for s in self.subs
+        ]))
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        filter: EventFilter | None = None,
+    ) -> Iterator[Event]:
+        from heapq import merge as heap_merge
+
+        if (
+            filter is not None
+            and filter.entity_type is not None
+            and filter.entity_id is not None
+        ):
+            # entity-pinned: one daemon holds the whole history
+            sub = self._home(
+                app_id, channel_id, filter.entity_type, filter.entity_id
+            )
+            return sub.find(app_id, channel_id, filter)
+        rows = _run_all([
+            (lambda s=s: list(s.find(app_id, channel_id, filter)))
+            for s in self.subs
+        ])
+        reverse = filter is not None and filter.reversed
+        limit = filter.limit if filter is not None else None
+
+        def gen():
+            count = 0
+            key = (
+                (lambda e: -e.event_time.timestamp())
+                if reverse
+                else (lambda e: e.event_time.timestamp())
+            )
+            for e in heap_merge(*rows, key=key):
+                if limit is not None and 0 <= limit <= count:
+                    return
+                count += 1
+                yield e
+
+        return gen()
+
+    def find_by_entity(
+        self,
+        app_id: int,
+        entity_type: str,
+        entity_id: str,
+        channel_id: int | None = None,
+        **kwargs,
+    ) -> Iterator[Event]:
+        return self._home(
+            app_id, channel_id, entity_type, entity_id
+        ).find_by_entity(
+            app_id, entity_type, entity_id, channel_id=channel_id, **kwargs
+        )
+
+
+class FanoutPEvents(base.PEvents):
+    """Bulk columnar DAO over D storage daemons (shard k -> daemon k%D)."""
+
+    def __init__(self, clients: Sequence[RemoteClient]):
+        self.subs = [RemotePEvents(c) for c in clients]
+        self._shards = _ShardCountCache(self.subs[0])
+
+    def n_shards(self, app_id: int, channel_id: int | None = None) -> int:
+        return self._shards.get(app_id, channel_id)
+
+    def write(
+        self, frame: EventFrame, app_id: int, channel_id: int | None = None
+    ) -> None:
+        if not len(frame):
+            return
+        n = self.n_shards(app_id, channel_id)
+        d = len(self.subs)
+        shard_of = base.frame_shard_of(frame.entity_type, frame.entity_id, n)
+        home = shard_of % d
+        calls = []
+        for h in range(d):
+            mask = home == h
+            if mask.any():
+                sub_frame = frame.take(mask)
+                calls.append(
+                    lambda h=h, f=sub_frame: self.subs[h].write(
+                        f, app_id, channel_id
+                    )
+                )
+        _run_all(calls)
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        filter: EventFilter | None = None,
+    ) -> EventFrame:
+        from predictionio_tpu.data.storage.base import concat_frames
+
+        if (
+            filter is not None
+            and filter.entity_type is not None
+            and filter.entity_id is not None
+        ):
+            n = self.n_shards(app_id, channel_id)
+            shard = base.entity_shard(filter.entity_type, filter.entity_id, n)
+            return self.subs[shard % len(self.subs)].find(
+                app_id, channel_id, filter
+            )
+        frames = _run_all([
+            (lambda s=s: s.find(app_id, channel_id, filter))
+            for s in self.subs
+        ])
+        out = concat_frames(frames)
+        # each daemon answers time-sorted; the concatenation must be
+        # re-sorted (and re-limited) to keep the find() contract
+        order = np.argsort(out.event_time_ms, kind="stable")
+        if filter is not None and filter.reversed:
+            order = order[::-1]
+        out = out.take(order)
+        if filter is not None and filter.limit is not None and filter.limit >= 0:
+            out = out.take(np.arange(min(filter.limit, len(out))))
+        return out
+
+    def iter_shards(
+        self,
+        app_id: int,
+        channel_id: int | None = None,
+        filter: EventFilter | None = None,
+        shards: Sequence[int] | None = None,
+    ) -> Iterator[tuple[int, EventFrame]]:
+        n = self.n_shards(app_id, channel_id)
+        want = list(shards) if shards is not None else list(range(n))
+        d = len(self.subs)
+        by_daemon: dict[int, list[int]] = {}
+        for k in want:
+            by_daemon.setdefault(k % d, []).append(k)
+        results = _run_all([
+            (
+                lambda h=h, ks=ks: list(
+                    self.subs[h].iter_shards(
+                        app_id, channel_id, filter, shards=ks
+                    )
+                )
+            )
+            for h, ks in by_daemon.items()
+        ])
+        got = {k: f for part in results for k, f in part}
+        for k in want:
+            if k in got:
+                yield k, got[k]
+
+    def delete(
+        self, event_ids: Sequence[str], app_id: int, channel_id: int | None = None
+    ) -> None:
+        if not event_ids:
+            return
+        # ids alone don't name a home daemon; a tombstone for an absent id
+        # is harmless, so broadcast
+        _run_all([
+            (lambda s=s: s.delete(event_ids, app_id, channel_id))
+            for s in self.subs
+        ])
+
+    def compact(self, app_id: int, channel_id: int | None = None) -> int | None:
+        rows = _run_all([
+            (lambda s=s: s.compact(app_id, channel_id)) for s in self.subs
+        ])
+        if all(r is None for r in rows):
+            return None
+        return sum(r or 0 for r in rows)
+
+    def status(self, app_id: int, channel_id: int | None = None) -> dict:
+        parts = _run_all([
+            (lambda s=s: s.status(app_id, channel_id)) for s in self.subs
+        ])
+        out = dict(parts[0])
+        out["daemons"] = len(parts)
+        for p in parts[1:]:
+            for key in (
+                "segments_hot",
+                "segments_compacted",
+                "backlog_segments",
+                "backlog_bytes",
+                "bytes",
+                "rows_hint",
+            ):
+                out[key] = out.get(key, 0) + p.get(key, 0)
+            lags = [
+                x.get("watermark_lag_s")
+                for x in (out, p)
+                if x.get("watermark_lag_s") is not None
+            ]
+            out["watermark_lag_s"] = max(lags) if lags else None
+        return out

@@ -118,20 +118,21 @@ class LEventStore:
         latest: bool = True,
     ) -> Iterator[Event]:
         app_id, channel_id = resolve_app(app_name, channel_name, self.storage)
-        return self.storage.l_events().find(
+        # the DAO-level point read: parquet answers this via segment and
+        # row-group skipping (docs/data_plane.md), fast enough to sit on
+        # the serving path
+        return self.storage.l_events().find_by_entity(
             app_id,
-            channel_id,
-            EventFilter(
-                start_time=start_time,
-                until_time=until_time,
-                entity_type=entity_type,
-                entity_id=entity_id,
-                event_names=tuple(event_names) if event_names else None,
-                target_entity_type=target_entity_type,
-                target_entity_id=target_entity_id,
-                limit=limit,
-                reversed=latest,
-            ),
+            entity_type,
+            entity_id,
+            channel_id=channel_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+            start_time=start_time,
+            until_time=until_time,
+            limit=limit,
+            reversed=latest,
         )
 
     def find(

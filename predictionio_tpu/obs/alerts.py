@@ -201,6 +201,14 @@ def default_rule_pack() -> list[AlertRule]:
             description="sustained load shedding: requests are being "
             "rejected at admission",
         ),
+        AlertRule(
+            "ingest_shed", "metric:pio_shed_total", 0.5, rate=True,
+            for_s=10.0, clear_band=0.3, severity="warning",
+            labels={"reason": "eventstore"},
+            description="event ingest is shedding 503s: the event-store "
+            "write queue is saturated (compaction backlog or a slow/"
+            "degraded storage daemon)",
+        ),
     ]
 
 

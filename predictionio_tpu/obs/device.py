@@ -805,9 +805,15 @@ def als_plan_roofline(plan: Mapping[str, Any]) -> dict[str, float] | None:
 #: (``fleet_*`` metrics + the ``fleet_replicas`` config echo, same
 #: cross-compare refusal); v5 adds the solo async-dispatch e2e number
 #: (``serving_solo_e2e_p50_ms`` — wall INCLUDING dispatch, the PR 12
-#: target), ``factor_cache_hit_rate``, and the fused-topk roofline block.
+#: target), ``factor_cache_hit_rate``, and the fused-topk roofline block;
+#: v6 grows the event-store section (``--events-scale``): throughput
+#: rates (``events_write_mb_s``/``events_scan_mb_s``), the per-user
+#: history latency (``events_user_history_p50_ms`` — the serving-path
+#: point read), and the post-compaction backlog echo
+#: (``events_compaction_backlog``), plus the ``events_scale_m`` config
+#: echo the gate refuses to cross-compare.
 #: ``pio bench --compare`` refuses version-less or older files.
-BENCH_SCHEMA_VERSION = 5
+BENCH_SCHEMA_VERSION = 6
 
 #: regression-gateable BENCH metrics and which direction is better.  Only
 #: keys present in BOTH files are compared; everything else (configuration
@@ -829,6 +835,12 @@ BENCH_GATE_METRICS: dict[str, str] = {
     "ncf_pretrain_s": "lower",
     "events20m_write_s": "lower",
     "events20m_scan_s": "lower",
+    # event-store data plane (schema v6): throughput up, serving-path
+    # history reads down, post-compaction backlog down
+    "events_write_mb_s": "higher",
+    "events_scan_mb_s": "higher",
+    "events_user_history_p50_ms": "lower",
+    "events_compaction_backlog": "lower",
     # throughput / quality / roofline: higher is better
     "vs_baseline": "higher",
     "map_at_10": "higher",
@@ -912,6 +924,17 @@ def compare_bench(
             f"fleet sections differ: current fleet_replicas={cur_fleet!r} "
             f"vs previous {prev_fleet!r} — re-run bench with the same "
             "--fleet to compare"
+        )
+        return 2, report
+    # event-store section config: a 100M-row write rate vs a 20M one is
+    # not the same measurement — refuse mismatched --events-scale runs
+    cur_ev = current.get("events_scale_m")
+    prev_ev = previous.get("events_scale_m")
+    if cur_ev != prev_ev:
+        report["error"] = (
+            f"event-store sections differ: current events_scale_m="
+            f"{cur_ev!r} vs previous {prev_ev!r} — re-run bench with the "
+            "same --events-scale to compare"
         )
         return 2, report
     for key in sorted(BENCH_GATE_METRICS):

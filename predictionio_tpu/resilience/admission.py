@@ -35,6 +35,7 @@ class AdmissionController:
         max_inflight: int,
         retry_after_s: float = 1.0,
         registry: MetricsRegistry | None = None,
+        reason: str = "inflight",
     ):
         if max_inflight < 1:
             raise ValueError("max_inflight must be >= 1")
@@ -46,11 +47,16 @@ class AdmissionController:
         # acquisitions are metered (pio_lock_wait_seconds{lock="admission"})
         self._lock = ContendedLock("admission", registry=reg)
         self._inflight = 0
+        # ``reason`` distinguishes controllers sharing one registry
+        # (single-VM deploys run the serving cap AND the event server's
+        # write gate): without the label both would write one gauge and
+        # ingest bursts would masquerade as serving load
         self._m_inflight = reg.gauge(
             "pio_inflight_requests",
-            "Requests currently admitted and executing",
-        )
-        self._m_shed = shed_counter(reg).labels("inflight")
+            "Requests currently admitted and executing, by admission gate",
+            labelnames=("reason",),
+        ).labels(reason)
+        self._m_shed = shed_counter(reg).labels(reason)
 
     def try_acquire(self) -> bool:
         with self._lock:

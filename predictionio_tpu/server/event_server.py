@@ -117,9 +117,11 @@ def create_event_server_app(
     registry: MetricsRegistry | None = None,
     obs_access_key: str | None = None,
     quality: QualityMonitor | None = None,
+    max_write_inflight: int | None = None,
 ) -> HTTPApp:
     import os
 
+    from predictionio_tpu.resilience.admission import AdmissionController
     from predictionio_tpu.server.plugins import PluginContext
 
     storage = storage or get_storage()
@@ -128,6 +130,44 @@ def create_event_server_app(
     levents = storage.l_events()
     plugins = plugins or PluginContext.from_env()
     registry = registry or REGISTRY
+    # Ingest backpressure: bound the event-store writes in flight so a
+    # slow/degraded store sheds 503 + Retry-After BEFORE the write
+    # amplifies into a pile of blocked handler threads (docs/data_plane.md).
+    # Counted as pio_shed_total{reason="eventstore"}; the default alert
+    # pack's ingest_shed rule pages on a sustained shed rate.
+    if max_write_inflight is None:
+        try:
+            max_write_inflight = int(os.environ.get("PIO_EVENT_MAX_INFLIGHT", 256))
+        except ValueError:
+            max_write_inflight = 256
+    ingest_gate = (
+        AdmissionController(
+            max_write_inflight, registry=registry, reason="eventstore"
+        )
+        if max_write_inflight and max_write_inflight > 0
+        else None
+    )
+
+    def gated_write(handler):
+        """503 + Retry-After when the write queue is saturated — applied
+        to every path that writes the event store."""
+
+        def wrapped(req: Request) -> Response:
+            from predictionio_tpu.server.httpd import shed_response
+
+            if ingest_gate is None:
+                return handler(req)
+            if not ingest_gate.try_acquire():
+                return shed_response(
+                    "event-store write queue saturated; retry later",
+                    ingest_gate.retry_after_s,
+                )
+            try:
+                return handler(req)
+            finally:
+                ingest_gate.release()
+
+        return wrapped
     # the feedback-joiner half of online model quality: ingested feedback
     # events join back to the prediction log this monitor holds.  Default
     # to the process-global monitor so a single-VM deployment (prediction +
@@ -222,6 +262,7 @@ def create_event_server_app(
 
     # -- single event CRUD ---------------------------------------------------
     @app.route("POST", "/events\\.json")
+    @gated_write
     @authed
     def post_event(req: Request, auth: AuthData) -> Response:
         try:
@@ -289,6 +330,7 @@ def create_event_server_app(
         return json_response(200, e.to_api_dict())
 
     @app.route("DELETE", "/events/(?P<event_id>[^/]+)\\.json")
+    @gated_write
     @authed
     def delete_event(req: Request, auth: AuthData) -> Response:
         found = levents.delete(req.params["event_id"], auth.app_id, auth.channel_id)
@@ -298,6 +340,7 @@ def create_event_server_app(
 
     # -- batch ---------------------------------------------------------------
     @app.route("POST", "/batch/events\\.json")
+    @gated_write
     @authed
     def post_batch(req: Request, auth: AuthData) -> Response:
         try:
@@ -393,6 +436,7 @@ def create_event_server_app(
         return json_response(201, {"eventId": event_id})
 
     @app.route("POST", "/webhooks/(?P<web>[^/]+)\\.json")
+    @gated_write
     @authed
     def post_webhook_json(req: Request, auth: AuthData) -> Response:
         web = req.params["web"]
@@ -423,6 +467,7 @@ def create_event_server_app(
         return json_response(200, {"message": "Ok"})
 
     @app.route("POST", "/webhooks/(?P<web>[^/]+)\\.form")
+    @gated_write
     @authed
     def post_webhook_form(req: Request, auth: AuthData) -> Response:
         web = req.params["web"]

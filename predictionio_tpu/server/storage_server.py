@@ -412,6 +412,61 @@ def create_storage_app(
         rows = fn(int(req.params["app"]), _chan(req))
         return json_response(200, {"supported": True, "rows": rows})
 
+    @app.route("GET", r"/v1/apps/(?P<app>\d+)/eventstore_status")
+    def fr_status(req: Request) -> Response:
+        pe = rt.p_events()
+        fn = getattr(pe, "status", None)
+        if fn is None:  # SQL stores have no segment layout to report
+            return json_response(200, {"supported": False})
+        return json_response(200, fn(int(req.params["app"]), _chan(req)))
+
+    @app.route("POST", r"/eventstore/compact")
+    def eventstore_compact(req: Request) -> Response:
+        """Fold every app on this daemon now (idempotent; the background
+        compactor also runs on its own cadence)."""
+        pe = rt.p_events()
+        fn = getattr(pe, "compact", None)
+        if fn is None:
+            return json_response(200, {"supported": False, "apps": 0, "rows": 0})
+        client = getattr(getattr(pe, "store", None), "client", None)
+        from predictionio_tpu.data.storage.compactor import Compactor
+
+        comp = getattr(app, "compactor", None) or (
+            Compactor(client) if client is not None else None
+        )
+        if comp is None:
+            return json_response(200, {"supported": False, "apps": 0, "rows": 0})
+        apps = 0
+        rows = 0
+        for app_id, channel_id in comp.app_keys():
+            rows += comp.store.compact(app_id, channel_id)
+            apps += 1
+        return json_response(
+            200, {"supported": True, "apps": apps, "rows": rows}
+        )
+
+    @app.route("GET", r"/eventstore\.json")
+    def eventstore_status(req: Request) -> Response:
+        """Aggregate segment/compaction status across every app on this
+        daemon — what ``pio eventstore status --url`` and the ``pio
+        status`` backlog WARNING read."""
+        comp = getattr(app, "compactor", None)
+        if comp is not None:
+            return json_response(200, comp.status())
+        # no background compactor: synthesize the same shape on demand
+        pe = rt.p_events()
+        client = getattr(getattr(pe, "store", None), "client", None)
+        if client is None:
+            return json_response(200, {"supported": False, "apps": []})
+        from predictionio_tpu.data.storage.compactor import (
+            CompactionPolicy,
+            Compactor,
+        )
+
+        return json_response(
+            200, Compactor(client, CompactionPolicy.from_env()).status()
+        )
+
     @app.route("POST", r"/v1/apps/(?P<app>\d+)/frame_delete")
     def fr_delete(req: Request) -> Response:
         ids = req.json().get("ids", [])
@@ -436,7 +491,13 @@ def runtime_for_root(root: str | Path, events: str = "parquet") -> StorageRuntim
 
 
 class StorageServer:
-    """Bind-and-serve wrapper (the daemon entry)."""
+    """Bind-and-serve wrapper (the daemon entry).
+
+    With ``compaction=True`` (the default for parquet event stores) the
+    daemon owns a background :class:`Compactor` that folds the write-hot
+    head into sorted compacted segments on a watermark cadence — the
+    HBase major-compaction role, continuous instead of operator-driven.
+    """
 
     def __init__(
         self,
@@ -445,19 +506,75 @@ class StorageServer:
         port: int = 7072,
         access_key: str | None = None,
         events: str = "parquet",
+        compaction: bool = True,
+        compact_interval_s: float | None = None,
     ):
         self.runtime = runtime_for_root(root, events=events)
         self.app = create_storage_app(self.runtime, access_key=access_key)
+        self.compactor = None
+        self._owner_lock = None
+        if events == "parquet":
+            # advisory ownership of the parquet root for the daemon's
+            # lifetime: other processes (CLI local compact) refuse to
+            # fold a root whose in-flight-write bookkeeping lives here
+            from predictionio_tpu.data.storage.parquet_backend import (
+                acquire_root_ownership,
+            )
+
+            pe0 = self.runtime.p_events()
+            client0 = getattr(getattr(pe0, "store", None), "client", None)
+            if client0 is not None:
+                self._owner_lock = acquire_root_ownership(client0.root)
+                if self._owner_lock is None:
+                    import logging
+
+                    logging.getLogger(
+                        "predictionio_tpu.server.storage"
+                    ).warning(
+                        "another process already owns storage root %s; "
+                        "two daemons on one root will corrupt compaction",
+                        client0.root,
+                    )
+        if compaction and events == "parquet":
+            from predictionio_tpu.data.storage.compactor import (
+                CompactionPolicy,
+                Compactor,
+            )
+
+            pe = self.runtime.p_events()
+            client = getattr(getattr(pe, "store", None), "client", None)
+            if client is not None:
+                policy = CompactionPolicy.from_env()
+                if compact_interval_s is not None:
+                    import dataclasses
+
+                    policy = dataclasses.replace(
+                        policy, interval_s=compact_interval_s
+                    )
+                self.compactor = Compactor(client, policy)
+                self.app.compactor = self.compactor
         self.server = AppServer(self.app, host=host, port=port)
         self.host, self.port = self.server.host, self.server.port
 
     def start_background(self) -> "StorageServer":
+        if self.compactor is not None:
+            self.compactor.start()
         self.server.start_background()
         return self
 
     def serve_forever(self) -> None:
+        if self.compactor is not None:
+            self.compactor.start()
         self.server.serve_forever()
 
     def shutdown(self) -> None:
+        if self.compactor is not None:
+            self.compactor.stop()
         self.server.shutdown()
         self.runtime.close()
+        if self._owner_lock is not None:
+            try:
+                self._owner_lock.close()  # releases the flock
+            except OSError:
+                pass
+            self._owner_lock = None

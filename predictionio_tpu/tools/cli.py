@@ -235,6 +235,27 @@ def _status_remote(
     # router, fold the membership registry — any ejected replica is an
     # operator-actionable WARNING, and a fleet with zero healthy replicas
     # cannot serve at all (exit 1 even if the router process is alive)
+    # event-store surface (404/401-tolerant): a compaction backlog over
+    # the watermark budget means scans are paying the write-hot head —
+    # operator-actionable WARNING, exit code unchanged (ingest still works)
+    es_status, es_body = fetch("/eventstore.json")
+    if es_status == 200 and "backlog_segments" in es_body:
+        report["eventstore"] = {
+            "backlog_segments": es_body.get("backlog_segments"),
+            "watermark_lag_s": es_body.get("watermark_lag_s"),
+            "compactor_running": es_body.get("running"),
+        }
+        if es_body.get("over_budget"):
+            budget = (es_body.get("policy") or {}).get(
+                "backlog_budget_segments"
+            )
+            print(
+                "WARNING: event-store compaction backlog "
+                f"{es_body.get('backlog_segments')} segments exceeds the "
+                f"watermark budget ({budget}); scans are paying the "
+                "write-hot head (see docs/data_plane.md#compaction)",
+                file=sys.stderr,
+            )
     fleet_dead = False
     fl_status, fleet_body = fetch("/fleet.json")
     if fl_status == 200 and isinstance(fleet_body.get("replicas"), list):
@@ -301,6 +322,138 @@ def do_app(args) -> int:
     elif args.app_command == "channel-delete":
         cmd.channel_delete(storage, args.name, args.channel)
         print(f"Channel {args.channel} deleted.")
+    return 0
+
+
+def _local_compactor():
+    """A Compactor over the locally-configured parquet event store, or
+    None when the event backend has no segment layout (SQL stores)."""
+    from predictionio_tpu.data.storage.compactor import (
+        CompactionPolicy,
+        Compactor,
+    )
+
+    pe = get_storage().p_events()
+    client = getattr(getattr(pe, "store", None), "client", None)
+    if client is None:
+        return None
+    return Compactor(client, CompactionPolicy.from_env())
+
+
+def _render_eventstore_status(st: dict) -> None:
+    """Human rendering of the /eventstore.json shape."""
+    pol = st.get("policy") or {}
+    print(
+        f"compactor: {'running' if st.get('running') else 'idle'}  "
+        f"backlog={st.get('backlog_segments')} segments"
+        + (
+            f" (budget {pol.get('backlog_budget_segments')})"
+            if pol
+            else ""
+        )
+    )
+    lag = st.get("watermark_lag_s")
+    if lag is not None:
+        print(f"watermark lag: {lag:.1f}s")
+    for a in st.get("apps", []):
+        if a.get("error"):
+            print(f"  app {a.get('app_id')}: ERROR {a['error']}")
+            continue
+        chan = (
+            f" channel {a['channel_id']}"
+            if a.get("channel_id") is not None
+            else ""
+        )
+        print(
+            f"  app {a.get('app_id')}{chan}: shards={a.get('n_shards')} "
+            f"hot={a.get('segments_hot')} "
+            f"compacted={a.get('segments_compacted')} "
+            f"bytes={a.get('bytes', 0):,} "
+            f"byte_skew={a.get('byte_skew_frac', 0):.2f} "
+            f"rows~{a.get('rows_hint', 0):,}"
+        )
+    if st.get("over_budget"):
+        print(
+            "WARNING: backlog exceeds the watermark budget; scans are "
+            "paying the write-hot head (docs/data_plane.md#compaction)"
+        )
+
+
+def do_eventstore(args) -> int:
+    """`pio eventstore status|compact`: the data-plane operator surface —
+    segment counts, compaction backlog, watermark lag, per-shard byte
+    skew; ``compact`` folds the write-hot head now."""
+    url = getattr(args, "url", None)
+    if url:
+        import urllib.request
+
+        base = url.rstrip("/")
+        headers = {}
+        key = getattr(args, "access_key", None)
+        if key:
+            headers["Authorization"] = f"Bearer {key}"
+
+        def call(method: str, path: str):
+            req = urllib.request.Request(
+                base + path, headers=headers, method=method
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read().decode("utf-8"))
+
+        try:
+            if args.es_command == "compact":
+                out = call("POST", "/eventstore/compact")
+            else:
+                out = call("GET", "/eventstore.json")
+        except Exception as e:
+            print(f"eventstore: {base} unreachable: {e}", file=sys.stderr)
+            return 1
+    else:
+        comp = _local_compactor()
+        if comp is None:
+            print(
+                "eventstore: the configured event backend has no segment "
+                "layout (SQL stores rewrite in place); nothing to report."
+            )
+            return 0
+        if args.es_command == "compact":
+            from predictionio_tpu.data.storage.parquet_backend import (
+                acquire_root_ownership,
+            )
+
+            owner = acquire_root_ownership(comp.client.root)
+            if owner is None:
+                print(
+                    "eventstore: another process (a storage daemon?) owns "
+                    f"root {comp.client.root}; folding from here could "
+                    "race its in-flight writes — compact THROUGH it with "
+                    "--url instead.",
+                    file=sys.stderr,
+                )
+                return 1
+            try:
+                apps = rows = 0
+                for app_id, channel_id in comp.app_keys():
+                    rows += comp.store.compact(app_id, channel_id)
+                    apps += 1
+                out = {"supported": True, "apps": apps, "rows": rows}
+            finally:
+                owner.close()
+        else:
+            out = comp.status()
+    if getattr(args, "json", False):
+        _print(out)
+    elif args.es_command == "compact":
+        print(
+            f"Compacted {out.get('apps', 0)} app(s): "
+            f"{out.get('rows', 0):,} live rows."
+            if out.get("supported", True)
+            else "Event store rewrites in place; nothing to compact."
+        )
+    else:
+        _render_eventstore_status(out)
+    if args.es_command == "status" and out.get("over_budget"):
+        return 1
     return 0
 
 
@@ -625,6 +778,8 @@ def do_storageserver(args) -> int:
         port=args.port,
         access_key=args.access_key,
         events=args.events,
+        compaction=not getattr(args, "no_compact", False),
+        compact_interval_s=getattr(args, "compact_interval", None),
     )
     print(f"Storage daemon on http://{args.ip}:{server.port} (root={args.root})")
     try:
@@ -2019,7 +2174,46 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ss.add_argument("--access-key", default=None)
     ss.add_argument("--events", choices=("parquet", "sqlite"), default="parquet")
+    ss.add_argument(
+        "--no-compact",
+        action="store_true",
+        help="disable the background segment compactor (on by default for "
+        "parquet stores; see docs/data_plane.md#compaction)",
+    )
+    ss.add_argument(
+        "--compact-interval",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="compactor tick cadence (default PIO_COMPACT_INTERVAL_S or 30)",
+    )
     ss.set_defaults(fn=do_storageserver)
+
+    est = sub.add_parser(
+        "eventstore",
+        help="event-store data plane: segment/compaction status and "
+        "on-demand compaction (docs/data_plane.md)",
+    )
+    essub = est.add_subparsers(dest="es_command", required=True)
+    for name, hlp in (
+        ("status", "segment counts, compaction backlog, watermark lag, "
+         "per-shard byte skew (exit 1 when backlog exceeds the budget)"),
+        ("compact", "fold the write-hot head into compacted segments now"),
+    ):
+        sp_es = essub.add_parser(name, help=hlp)
+        sp_es.add_argument(
+            "--url",
+            default=None,
+            help="a running storage daemon (default: the locally "
+            "configured store; when a daemon serves this root, compact "
+            "THROUGH it with --url — its process owns the in-flight "
+            "write bookkeeping that makes folding safe)",
+        )
+        sp_es.add_argument("--access-key", default=None)
+        sp_es.add_argument(
+            "--json", action="store_true", help="machine-readable output"
+        )
+    est.set_defaults(fn=do_eventstore)
 
     dm = sub.add_parser("daemon")
     dm.add_argument("pidfile")

@@ -1,0 +1,42 @@
+"""Fixture: PIO-RES004 — unbounded parquet reads in storage paths."""
+
+import pyarrow.dataset as ds
+import pyarrow.parquet as pq
+
+
+def scan_bad(path):
+    return pq.read_table(path)  # line 8: RES004 (no columns/filters)
+
+
+def scan_chain_bad(path):
+    return pq.ParquetFile(path).read()  # line 12: RES004
+
+
+def scan_dataset_bad(path):
+    return ds.dataset(path, format="parquet").to_table()  # line 16: RES004
+
+
+def scan_projected_good(path):
+    return pq.read_table(path, columns=["entity_id", "seq"])  # clean
+
+
+def scan_filtered_good(path, expr):
+    return pq.read_table(path, filters=expr)  # clean
+
+
+def scan_chain_good(path):
+    # an explicit full column list is a deliberate bound, not an accident
+    return pq.ParquetFile(path).read(columns=["entity_id"])  # clean
+
+
+def scan_dataset_good(path, expr):
+    dset = ds.dataset(path, format="parquet")
+    return dset.to_table(columns=["entity_id"], filter=expr)  # clean
+
+
+def scan_kwargs_good(path, **kw):
+    return pq.read_table(path, **kw)  # clean: **kwargs may carry a bound
+
+
+def file_read_ok(fh):
+    return fh.read()  # clean: not a ParquetFile chain

@@ -115,6 +115,19 @@ class TestRuleCorpus:
             ("PIO-RES003", 47, "medium"),
         ]
 
+    def test_res004_full_table_materialization(self):
+        assert triples("res004_storage_full_read.py") == [
+            ("PIO-RES004", 8, "medium"),
+            ("PIO-RES004", 12, "medium"),
+            ("PIO-RES004", 16, "medium"),
+        ]
+
+    def test_res004_scoped_to_storage_modules(self):
+        """The same unbounded read OUTSIDE a storage-pathed module (e.g.
+        an analysis notebook helper) stays clean."""
+        src = (FIXTURES / "res004_storage_full_read.py").read_text()
+        assert analyze_source(src, "some_module.py") == []
+
     def test_res003_scoped_to_storage_modules(self):
         """The same direct write OUTSIDE a storage-pathed module is not a
         persistence path and stays clean."""
@@ -139,6 +152,7 @@ class TestRuleCorpus:
                 "res001_timeout.py",
                 "res002_swallow.py",
                 "res003_storage_write.py",
+                "res004_storage_full_read.py",
             )
             for f in findings_for(name)
         }
