@@ -1796,6 +1796,112 @@ def main() -> None:
             f"peak ~{peaks.hbm_gbps:.0f})"
         )
 
+    def sec_cost_attribution():
+        # schema v7: who-costs-what — per-query attributed device cost
+        # through the metered solo path, the metering tax (same loop with
+        # and without ledger billing), attribution conservation (ledger
+        # totals vs what the loop measured), and the event-visibility
+        # freshness echo from the event_store section's compaction
+        from predictionio_tpu.models.recommendation.engine import (
+            ALSAlgorithm,
+            Query as ALSQuery,
+        )
+        from predictionio_tpu.obs.costs import CostLedger, request_cost
+        from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
+
+        model = build_als_model(C.state, num_users, num_items)
+        algo = ALSAlgorithm()
+        ledger = CostLedger(window_s=3600.0, registry=MetricsRegistry())
+        measured = {"s": 0.0}
+
+        def run_loop(n, metered):
+            laps = []
+            for u in range(n):
+                t0 = time.perf_counter()
+                if metered:
+                    with request_cost(
+                        "bench-als", "/queries.json", "als", ledger=ledger
+                    ) as rec:
+                        t1 = time.perf_counter()
+                        algo.predict(
+                            model, ALSQuery(user=str(u % 100), num=K)
+                        )
+                        d = time.perf_counter() - t1
+                        rec.add(device_s=d)
+                    measured["s"] += d
+                else:
+                    algo.predict(model, ALSQuery(user=str(u % 100), num=K))
+                laps.append(time.perf_counter() - t0)
+            laps.sort()
+            return laps
+
+        run_loop(8, metered=False)  # warm compile + factor cache
+        n = 200
+        plain = run_loop(n, metered=False)
+        billed = run_loop(n, metered=True)
+        p50_plain = plain[n // 2] * 1000
+        p50_billed = billed[n // 2] * 1000
+        overhead_pct = (
+            (p50_billed - p50_plain) / p50_plain * 100 if p50_plain else 0.0
+        )
+        block: dict = {
+            "als_requests": n,
+            "als_p50_unmetered_ms": round(p50_plain, 3),
+            "als_p50_metered_ms": round(p50_billed, 3),
+        }
+        # NCF rides along when its section trained a model this run
+        if hasattr(C, "ncf_state"):
+            from predictionio_tpu.models.ncf.engine import (
+                NCFAlgorithm,
+                Query as NCFQuery,
+            )
+
+            ncf_model = build_ncf_model(C.ncf_state, num_users, num_items)
+            ncf_algo = NCFAlgorithm()
+            n_ncf = 60
+            for u in range(4):
+                ncf_algo.predict(ncf_model, NCFQuery(user=str(u), num=K))
+            for u in range(n_ncf):
+                with request_cost(
+                    "bench-ncf", "/queries.json", "ncf", ledger=ledger
+                ) as rec:
+                    t1 = time.perf_counter()
+                    ncf_algo.predict(
+                        ncf_model, NCFQuery(user=str(u % 100), num=K)
+                    )
+                    d = time.perf_counter() - t1
+                    rec.add(device_s=d)
+                measured["s"] += d
+            block["ncf_requests"] = n_ncf
+        snap = ledger.snapshot()
+        attributed_s = 0.0
+        for row in snap["totals"]:
+            dev_us = row["device_s"] / max(row["requests"], 1) * 1e6
+            attributed_s += row["device_s"]
+            if row["app"] == "bench-als":
+                metrics["cost_als_device_us_per_query"] = round(dev_us, 1)
+            elif row["app"] == "bench-ncf":
+                metrics["cost_ncf_device_us_per_query"] = round(dev_us, 1)
+        coverage = attributed_s / measured["s"] if measured["s"] else 0.0
+        metrics["cost_metering_overhead_pct"] = round(overhead_pct, 2)
+        metrics["cost_attribution_coverage_frac"] = round(coverage, 4)
+        fam = REGISTRY.get("pio_event_visibility_lag_p99_seconds")
+        if fam is not None:
+            vals = [g.value for _, g in fam.series()]
+            if vals:
+                metrics["events_visibility_lag_p99_s"] = round(
+                    max(vals), 3
+                )
+        metrics["cost_attribution"] = block
+        log(
+            f"# cost_attribution: als="
+            f"{metrics.get('cost_als_device_us_per_query', 0)}us/query "
+            f"ncf={metrics.get('cost_ncf_device_us_per_query', 'n/a')}"
+            f"us/query metering_overhead={overhead_pct:+.2f}% "
+            f"coverage={coverage:.4f} visibility_p99="
+            f"{metrics.get('events_visibility_lag_p99_s', 'n/a')}s"
+        )
+
     # --events-scale N: run the event-store section over N MILLION
     # synthetic rows instead of the train arrays (the slow 100M-row data-
     # plane mode; only runs when explicitly requested)
@@ -1864,6 +1970,7 @@ def main() -> None:
         if hasattr(C, "state"):
             run_section("als_serving", sec_als_serving)
             run_section("fused_topk", sec_fused_topk)
+            run_section("cost_attribution", sec_cost_attribution)
         else:
             failed.append("als_serving")
             log("# SECTION als_serving SKIPPED: no trained ALS state")

@@ -10,6 +10,8 @@ families:
                   unlocked mutation of lock-guarded state (rules_concurrency)
   - PIO-RES00x  — network calls without timeouts, silent exception
                   swallowing on serving hot paths (rules_resilience)
+  - PIO-OBS00x  — route dispatch that bypasses the request-latency
+                  middleware, creating metrics-dark traffic (rules_obs)
   - PIO-DASE00x — DataSource->Preparator->Algorithm->Serving signature /
                   params-dataclass contract checks (contract; import-based,
                   lazily loaded so plain lint runs never import jax)
@@ -38,6 +40,7 @@ from predictionio_tpu.analysis.rules import ALL_RULES, Rule  # noqa: F401
 # importing the rule modules registers them in ALL_RULES
 from predictionio_tpu.analysis import rules_concurrency  # noqa: E402,F401
 from predictionio_tpu.analysis import rules_jax  # noqa: E402,F401
+from predictionio_tpu.analysis import rules_obs  # noqa: E402,F401
 from predictionio_tpu.analysis import rules_resilience  # noqa: E402,F401
 
 __all__ = [

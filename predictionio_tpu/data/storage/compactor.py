@@ -199,6 +199,21 @@ class Compactor:
             "backlog_segments": backlog,
             "over_budget": backlog > self.policy.backlog_budget_segments,
             "watermark_lag_s": max(lags) if lags else None,
+            "visibility": self._visibility(),
             "apps": apps,
             "last_tick": self.last_tick,
+        }
+
+    @staticmethod
+    def _visibility() -> dict[str, Any]:
+        """Event-to-visible freshness quantiles (process lifetime, row
+        weighted) from the ``pio_event_visibility_lag_seconds`` histogram
+        this process's compaction passes feed."""
+        from predictionio_tpu.data.storage.parquet_backend import _metrics
+
+        h = _metrics()["visibility_lag"]
+        return {
+            "rows_observed": h.count,
+            "lag_p50_s": h.quantile(0.50) if h.count else None,
+            "lag_p99_s": h.quantile(0.99) if h.count else None,
         }

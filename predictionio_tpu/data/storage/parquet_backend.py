@@ -79,6 +79,7 @@ from predictionio_tpu.data.storage.base import (
     run_concurrent,
 )
 from predictionio_tpu.data.storage.frame_codec import dictionary_to_objects
+from predictionio_tpu.obs.costs import note_storage_read
 from predictionio_tpu.resilience import faults
 
 log = logging.getLogger("predictionio_tpu.data.parquet")
@@ -213,6 +214,17 @@ def _metrics() -> dict[str, Any]:
                         "pio_eventstore_compaction_seconds",
                         "Wall time of one compaction pass",
                         buckets=TRAIN_BUCKETS,
+                    ),
+                    "visibility_lag": REGISTRY.histogram(
+                        "pio_event_visibility_lag_seconds",
+                        "Event-to-visible lag: publish-to-compaction age of "
+                        "each row folded out of the hot tier",
+                        buckets=TRAIN_BUCKETS,
+                    ),
+                    "visibility_lag_p99": REGISTRY.gauge(
+                        "pio_event_visibility_lag_p99_seconds",
+                        "p99 of pio_event_visibility_lag_seconds (alertable "
+                        "scalar mirror)",
                     ),
                 }
     return _M
@@ -1191,6 +1203,7 @@ class ParquetEventStore:
             skipped += cseg.size
 
         m["bytes_read"].labels(kind).inc(read_bytes)
+        note_storage_read(read_bytes)
         if skipped:
             m["bytes_skipped"].labels(kind).inc(skipped)
         if not parts:
@@ -1364,6 +1377,7 @@ class ParquetEventStore:
             skipped += cseg.size
 
         m["bytes_read"].labels("entity").inc(read_bytes)
+        note_storage_read(read_bytes)
         m["bytes_skipped"].labels("entity").inc(skipped)
         m["scan_s"].labels("entity").observe(time.perf_counter() - t0)
         if not parts:
@@ -1528,6 +1542,27 @@ class ParquetEventStore:
             )
         if faults.ACTIVE is not None:
             faults.ACTIVE.check("compact.publish", shard_dir.name)
+        # event-to-visible freshness: each hot segment's seq is its publish
+        # timestamp (ns), so now - seq is exactly how long its rows sat in
+        # the write-hot tier before this fold made them compaction-visible.
+        # Row-weighted so one giant stale segment moves the quantile as much
+        # as many small ones.  Measured before the unlink (the footer read
+        # needs the file) but after the publish, so a crash between the two
+        # can at worst double-observe, never lose the segment itself.
+        if hots:
+            m = _metrics()
+            lag_now = time.time()
+            for s in hots:
+                try:
+                    sstats = self.client.seg_stats(s.path)
+                    rows = int(sstats.get("rows", 0)) if sstats else 0
+                except Exception:
+                    rows = 0
+                if rows <= 0:
+                    rows = 1
+                lag = max(lag_now - s.seq / 1e9, 0.0)
+                m["visibility_lag"].observe_many(lag, rows)
+            m["visibility_lag_p99"].set(m["visibility_lag"].quantile(0.99))
         for s in folded + superseded:
             if s.path != new_path or t is None:
                 s.path.unlink(missing_ok=True)

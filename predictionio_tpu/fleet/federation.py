@@ -252,6 +252,71 @@ def federated_alerts(
     }
 
 
+# ---------------------------------------------------------------------------
+# /costs.json federation
+
+
+def federated_costs(
+    bodies: Mapping[str, Mapping[str, Any]],
+    errors: Mapping[str, str],
+    local_snapshot: Mapping[str, Any] | None = None,
+    local_label: str = "router",
+) -> dict[str, Any]:
+    """Merge ``/costs.json`` bodies into one fleet body: every replica's
+    per-(app, route, variant) total rides replica-tagged in ``totals``
+    (``pio costs`` renders them as ``app@replica``), and ``merged`` sums
+    the same keys fleet-wide — the substrate a fleet-level quota or the
+    ``cost_skew`` question "who costs what, anywhere" reads.  A replica
+    whose scrape failed is named in ``source_errors`` and simply absent
+    from the rows."""
+    from predictionio_tpu.obs.costs import COST_FIELDS
+
+    sources: list[tuple[str, Mapping[str, Any]]] = []
+    if local_snapshot is not None:
+        sources.append((local_label, local_snapshot))
+    sources.extend((rid, bodies[rid]) for rid in sorted(bodies))
+    rows: list[dict[str, Any]] = []
+    merged: dict[tuple[str, str, str], dict[str, float]] = {}
+    replicas: list[str] = []
+    budgets: dict[str, Any] = {"per_app": {}, "default_device_s_per_min": None}
+    for rid, body in sources:
+        replicas.append(rid)
+        b = body.get("budgets") or {}
+        budgets["per_app"].update(b.get("per_app") or {})
+        if budgets["default_device_s_per_min"] is None:
+            budgets["default_device_s_per_min"] = b.get(
+                "default_device_s_per_min"
+            )
+        for row in body.get("totals") or ():
+            rows.append({**row, "replica": rid})
+            key = (
+                str(row.get("app", "?")),
+                str(row.get("route", "")),
+                str(row.get("variant", "")),
+            )
+            agg = merged.setdefault(key, dict.fromkeys(COST_FIELDS, 0.0))
+            for f in COST_FIELDS:
+                try:
+                    agg[f] += float(row.get(f, 0.0) or 0.0)
+                except (TypeError, ValueError):
+                    pass
+    rows.sort(key=lambda r: -float(r.get("device_s", 0.0) or 0.0))
+    merged_rows = [
+        {"app": k[0], "route": k[1], "variant": k[2], **agg}
+        for k, agg in sorted(
+            merged.items(), key=lambda kv: -kv[1]["device_s"]
+        )
+    ]
+    return {
+        "fleet": True,
+        "replicas": replicas,
+        "totals": rows,
+        "merged": merged_rows,
+        "budgets": budgets,
+        "source_errors": {rid: errors[rid] for rid in sorted(errors)},
+    }
+
+
 class FederationCache:
     """One cached aggregation per key, rebuilt at most every
     :data:`CACHE_TTL_S`, with SINGLE-FLIGHT rebuilds — the router's

@@ -181,6 +181,7 @@ def create_router_app(
     from predictionio_tpu.fleet.federation import (
         FederationCache,
         federated_alerts,
+        federated_costs,
         federated_metrics_text,
         scrape_replicas,
     )
@@ -398,6 +399,28 @@ def create_router_app(
             )
 
         return json_response(200, fed_cache.get("alerts", build))
+
+    @app.route("GET", "/costs\\.json")
+    def federated_costs_json(req: Request) -> Response:
+        """Every replica's cost ledger in one body: replica-tagged rows
+        plus fleet-wide merged per-(app, route, variant) sums — the
+        `pio costs --url <router>` and `pio top` fold."""
+        if not _authorized(req):
+            return error_response(401, "Invalid accessKey.")
+
+        def build() -> dict:
+            bodies, errors = scrape_replicas(fleet, "/costs.json")
+            local = getattr(app, "costs", None)
+            return federated_costs(
+                bodies,
+                errors,
+                local_snapshot=(
+                    local.snapshot() if local is not None else None
+                ),
+                local_label="router",
+            )
+
+        return json_response(200, fed_cache.get("costs", build))
 
     @app.route("GET", "/fleet\\.json")
     def fleet_json(req: Request) -> Response:

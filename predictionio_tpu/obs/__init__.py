@@ -23,6 +23,14 @@ from predictionio_tpu.obs.alerts import (
     default_rule_pack,
     resolve_rules,
 )
+from predictionio_tpu.obs.costs import (
+    CostLedger,
+    RequestCost,
+    current_cost,
+    default_ledger,
+    note_storage_read,
+    request_cost,
+)
 from predictionio_tpu.obs.device import (
     DEVICE_EFFICIENCY,
     RECOMPILES,
@@ -100,6 +108,7 @@ __all__ = [
     "SLOTracker",
     "STAGE_BUCKETS",
     "TRAIN_BUCKETS",
+    "CostLedger",
     "Counter",
     "DriftDetector",
     "Gauge",
@@ -110,12 +119,15 @@ __all__ = [
     "QualityMonitor",
     "RECOMPILES",
     "RecompileTracker",
+    "RequestCost",
     "Span",
     "annotate",
     "clear_traces",
     "compare_bench",
     "configure_logging",
+    "current_cost",
     "current_span",
+    "default_ledger",
     "default_quality",
     "default_registry",
     "default_rule_pack",
@@ -128,7 +140,9 @@ __all__ = [
     "get_request_id",
     "install_jax_compile_listener",
     "new_request_id",
+    "note_storage_read",
     "observe_span",
+    "request_cost",
     "quantile_from_buckets",
     "recent_traces",
     "reset_request_context",

@@ -82,7 +82,11 @@ class AlertRule:
       (closed=0, half-open=1, open=2), keyed by endpoint;
     - ``capacity.headroom_frac`` — the capacity model's headroom (absent
       until the model has a computable ceiling, so a cold process can't
-      false-fire a "no headroom" alert).
+      false-fire a "no headroom" alert);
+    - ``costs.burn_vs_budget`` / ``costs.device_share`` — the app's cost
+      ledger (obs/costs.py), keyed per app: device-seconds/min over the
+      configured budget, and each app's fraction of attributed device
+      time (silent below two active apps).
 
     ``labels`` filters metric selectors to series whose labels contain the
     given items.  The condition is ``value > threshold`` (direction
@@ -208,6 +212,26 @@ def default_rule_pack() -> list[AlertRule]:
             description="event ingest is shedding 503s: the event-store "
             "write queue is saturated (compaction backlog or a slow/"
             "degraded storage daemon)",
+        ),
+        AlertRule(
+            "cost_burn", "costs.burn_vs_budget", 1.0, for_s=10.0,
+            clear_band=0.2, severity="warning",
+            description="an app is burning device-seconds faster than its "
+            "configured budget (PIO_COST_BUDGETS) accrues",
+        ),
+        AlertRule(
+            "cost_skew", "costs.device_share", 0.75, for_s=10.0,
+            clear_band=0.1, severity="warning",
+            description="one app is consuming >75% of this process's "
+            "attributed device time: a noisy tenant is starving the rest",
+        ),
+        AlertRule(
+            "freshness_lag",
+            "metric:pio_event_visibility_lag_p99_seconds", 60.0,
+            for_s=15.0, clear_band=10.0, severity="warning",
+            description="event-ack to scan-visible (compaction fold) p99 "
+            "lag is over a minute: the freshness SLO input is degrading "
+            "(compaction stalled or backlogged)",
         ),
     ]
 
@@ -492,6 +516,22 @@ class AlertEvaluator:
                 "headroom_frac"
             )
             return {"": float(v)} if isinstance(v, (int, float)) else {}
+        if sel.startswith("costs."):
+            # per-app signals from the cost ledger: each app keys its own
+            # alert instance, so "cost_skew" names WHICH tenant is noisy
+            ledger = getattr(self.app, "costs", None)
+            if ledger is None:
+                return {}
+            try:
+                return {
+                    f"app={a}": float(v)
+                    for a, v in ledger.signal(sel[len("costs."):]).items()
+                }
+            except Exception:
+                log.exception(
+                    "alert rule %s: cost signal %s failed", rule.name, sel
+                )
+                return {}
         log.warning("alert rule %s: unknown selector %s", rule.name, sel)
         return {}
 

@@ -605,7 +605,8 @@ class WaveTimeline:
 
     __slots__ = (
         "stages", "device", "fn", "flops", "bytes", "transfers", "shards",
-        "shard_seconds", "cache_hits",
+        "shard_seconds", "cache_hits", "cache_misses", "cache_miss_bytes",
+        "storage_bytes",
     )
 
     def __init__(self):
@@ -619,6 +620,15 @@ class WaveTimeline:
         #: entity whose gather was skipped — flows into per-item meta as
         #: ``cache_hits`` so flight entries prove gather ~ 0 on a hit
         self.cache_hits: int = 0
+        #: ... and the misses, with the bytes their resolving fetch moved
+        #: (note_cache_miss / note_cache_fill): the cost ledger bills a hit
+        #: as ≈0 bytes and a miss as its fetch bytes (obs/costs.py)
+        self.cache_misses: int = 0
+        self.cache_miss_bytes: float = 0.0
+        #: event-store bytes read inside this wave (costs.note_storage_read
+        #: lands here when no request record is bound — the wave total is
+        #: prorated back to members through per-item meta)
+        self.storage_bytes: float = 0.0
         #: per-device byte/shard attribution of a SHARDED wave (filled by
         #: note_wave_shards; flows into per-item meta -> flight entries)
         self.shards: dict[str, dict[str, float]] = {}
@@ -682,6 +692,22 @@ def note_cache_hit(n: int = 1) -> None:
     tl = _timeline_var.get()
     if tl is not None:
         tl.cache_hits += n
+
+
+def note_cache_miss(n: int = 1) -> None:
+    """Record ``n`` factor-cache misses on the current wave — each one paid
+    the real gather its hit-twin skipped."""
+    tl = _timeline_var.get()
+    if tl is not None:
+        tl.cache_misses += n
+
+
+def note_cache_fill(nbytes: float) -> None:
+    """Record the bytes a cache-miss fetch moved into the cache on the
+    current wave (the miss side of the cost ledger's hit-vs-miss split)."""
+    tl = _timeline_var.get()
+    if tl is not None:
+        tl.cache_miss_bytes += float(nbytes)
 
 
 def note_wave_cost(fn: str, cost: Mapping[str, float] | None) -> None:
@@ -811,9 +837,16 @@ def als_plan_roofline(plan: Mapping[str, Any]) -> dict[str, float] | None:
 #: history latency (``events_user_history_p50_ms`` — the serving-path
 #: point read), and the post-compaction backlog echo
 #: (``events_compaction_backlog``), plus the ``events_scale_m`` config
-#: echo the gate refuses to cross-compare.
+#: echo the gate refuses to cross-compare; v7 adds the ``cost_attribution``
+#: block: per-query attributed device cost for the ALS and NCF serving
+#: paths (``cost_als_device_us_per_query`` / ``cost_ncf_device_us_per_query``),
+#: metering overhead (``cost_metering_overhead_pct`` — serving p50 with the
+#: ledger billing vs without), and the attribution coverage fraction
+#: (``cost_attribution_coverage_frac`` — attributed device-seconds over
+#: measured device-seconds, 1.0 when conservation holds), plus the
+#: event-visibility freshness p99 echo (``events_visibility_lag_p99_s``).
 #: ``pio bench --compare`` refuses version-less or older files.
-BENCH_SCHEMA_VERSION = 6
+BENCH_SCHEMA_VERSION = 7
 
 #: regression-gateable BENCH metrics and which direction is better.  Only
 #: keys present in BOTH files are compared; everything else (configuration
@@ -862,6 +895,12 @@ BENCH_GATE_METRICS: dict[str, str] = {
     "fleet_router_p50_ms": "lower",
     "fleet_router_p99_ms": "lower",
     "fleet_router_overhead_ms": "lower",
+    # cost-attribution section (schema v7): the metering tax must stay
+    # negligible, attribution must stay conservative (coverage ~1.0), and
+    # the freshness signal must not quietly decay
+    "cost_metering_overhead_pct": "lower",
+    "cost_attribution_coverage_frac": "higher",
+    "events_visibility_lag_p99_s": "lower",
 }
 
 

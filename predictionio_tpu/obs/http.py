@@ -20,6 +20,8 @@ onto a server:
                             any active recompile storm), transfer tallies
   GET  /alerts.json         the alert evaluator's live state: firing/pending
                             instances, recent transitions, the rule set
+  GET  /costs.json          the per-app cost ledger: open + closed windows
+                            of (app, route, variant) resource rollups
   GET  /incidents.json      recorded incident bundles (newest first)
   GET  /incidents/<id>.json one full bundle (replayable by pio trace --file)
   GET  /healthz             liveness — ALWAYS ungated (load balancers carry
@@ -79,6 +81,8 @@ _OBS_PATHS = frozenset(
         "/fleet.json",
         "/alerts.json",
         "/incidents.json",
+        "/costs.json",
+        "/eventstore.json",
         "/healthz",
         "/readyz",
         "/slo.json",
@@ -153,6 +157,7 @@ def add_observability_routes(
     hotpath: Any | None = None,
     alerts: Any | None = None,
     incidents: Any | None = None,
+    costs: Any | None = None,
 ):
     """The full observability surface: metrics + logs + flight + profiler +
     health.  Installs ``app.slo`` / ``app.flight`` / ``app.readiness`` so
@@ -215,6 +220,8 @@ def add_observability_routes(
         app.alerts = alerts
     if incidents is not None:
         app.incidents = incidents
+    if costs is not None:
+        app.costs = costs
     ring = get_log_ring()
 
     original_route = app.route
@@ -292,6 +299,24 @@ def add_observability_routes(
                 limit=min(max(limit, 0), 256),
             ),
         )
+
+    # -- per-app cost ledger -------------------------------------------------
+    # lives on the SCRAPE surface (not debug-gated): the same rollups are
+    # already exposed as pio_cost_* series on /metrics, and the event
+    # server's no-debug port must still answer `pio costs` / federation
+    if costs is not None:
+
+        @route("GET", "/costs\\.json")
+        def costs_json(req: Request) -> Response:
+            windows = None
+            if "windows" in req.query:
+                try:
+                    windows = int(req.query["windows"])
+                except ValueError:
+                    return json_response(
+                        400, {"message": "windows must be an integer"}
+                    )
+            return json_response(200, app.costs.snapshot(windows=windows))
 
     if not debug_routes:
         _add_health_routes(app, route)

@@ -148,6 +148,20 @@ class Histogram:
             self._sum += value
             self._count += 1
 
+    def observe_many(self, value: float, n: int) -> None:
+        """Record ``n`` identical observations with one bucket update.
+
+        Used by row-weighted observers (e.g. visibility lag weighted by
+        segment row count) where per-row ``observe`` calls would be O(rows).
+        """
+        if n <= 0:
+            return
+        idx = bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[idx] += n
+            self._sum += value * n
+            self._count += n
+
     def snapshot(self) -> tuple[list[int], float, int]:
         """(per-bucket counts, sum, count) — consistent under the lock."""
         with self._lock:

@@ -32,6 +32,7 @@ import weakref
 from collections import OrderedDict
 from typing import Any, Iterable
 
+from predictionio_tpu.obs import device as device_obs
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
 
 #: default per-model entry bound (rows, not bytes: a rank-32 f32 row is
@@ -44,6 +45,17 @@ def _capacity_from_env() -> int:
         return max(int(os.environ.get("PIO_FACTOR_CACHE_ROWS", "")), 0)
     except ValueError:
         return DEFAULT_CAPACITY
+
+
+def _row_nbytes(row: Any) -> float:
+    """Bytes a cached row occupies: arrays answer ``nbytes`` themselves,
+    engines that cache (index, row) tuples sum their parts."""
+    n = getattr(row, "nbytes", None)
+    if isinstance(n, (int, float)):
+        return float(n)
+    if isinstance(row, (tuple, list)):
+        return float(sum(_row_nbytes(part) for part in row))
+    return 0.0
 
 
 class FactorCache:
@@ -97,6 +109,11 @@ class FactorCache:
                 self._rows.move_to_end(entity_id)
         if row is None:
             self._m_misses.inc()
+            # the cost ledger's hit-vs-miss split: a miss pays the real
+            # gather, so it lands on the wave timeline (the hit twin is
+            # noted by the engine via note_cache_hit, which proves the
+            # gather was skipped); the fetch bytes follow through put()
+            device_obs.note_cache_miss()
         else:
             self._m_hits.inc()
         self._update_rate()
@@ -105,6 +122,9 @@ class FactorCache:
     def put(self, entity_id: Any, row: Any) -> None:
         if self.capacity <= 0 or row is None:
             return
+        # a put is a resolved miss: bill the fetched row's bytes to the
+        # wave that paid the gather (≈0 for its hit twin)
+        device_obs.note_cache_fill(_row_nbytes(row))
         evicted = 0
         with self._lock:
             before = len(self._rows)

@@ -37,6 +37,11 @@ from predictionio_tpu.data.webhooks import (
     to_event,
 )
 from predictionio_tpu.data.datamap import parse_event_time
+from predictionio_tpu.obs.costs import (
+    CostLedger,
+    default_ledger,
+    request_cost,
+)
 from predictionio_tpu.obs.http import add_observability_routes
 from predictionio_tpu.obs.logging import get_request_id
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
@@ -118,6 +123,10 @@ def create_event_server_app(
     obs_access_key: str | None = None,
     quality: QualityMonitor | None = None,
     max_write_inflight: int | None = None,
+    #: per-app cost ledger (docs/observability.md#cost-attribution): None =
+    #: the process default on the default registry, so a single-VM deploy
+    #: bills ingest and serving into one rollup
+    costs: "CostLedger | None" = None,
 ) -> HTTPApp:
     import os
 
@@ -130,6 +139,23 @@ def create_event_server_app(
     levents = storage.l_events()
     plugins = plugins or PluginContext.from_env()
     registry = registry or REGISTRY
+    # the cost ledger bills ingest by access-key app id; id-bearing paths
+    # collapse so ledger keys stay low-cardinality
+    if costs is None:
+        costs = (
+            default_ledger()
+            if registry is REGISTRY
+            else CostLedger(registry=registry)
+        )
+    app.costs = costs
+
+    def _cost_route(path: str) -> str:
+        path = path.split("?", 1)[0]
+        if path.startswith("/events/"):
+            return "/events/*.json"
+        if path.startswith("/webhooks/"):
+            return "/webhooks/*"
+        return path
     # Ingest backpressure: bound the event-store writes in flight so a
     # slow/degraded store sheds 503 + Retry-After BEFORE the write
     # amplifies into a pile of blocked handler threads (docs/data_plane.md).
@@ -158,6 +184,9 @@ def create_event_server_app(
             if ingest_gate is None:
                 return handler(req)
             if not ingest_gate.try_acquire():
+                # shed before auth: no app identity yet, so the ledger
+                # carries it under the shared "unknown" row
+                costs.note_shed("unknown", _cost_route(req.path), "ingest")
                 return shed_response(
                     "event-store write queue saturated; retry later",
                     ingest_gate.retry_after_s,
@@ -207,6 +236,7 @@ def create_event_server_app(
             "metadata_store": _metadata_ready,
         },
         quality=quality,
+        costs=costs,
     )
     m_ingested = registry.counter(
         "pio_events_ingested_total",
@@ -223,7 +253,16 @@ def create_event_server_app(
             except _STORE_UNAVAILABLE as e:
                 # key lookup needs the metadata store: down -> retryable
                 return _unavailable_response(e)
-            return handler(req, auth)
+            # every authenticated call runs under a bound RequestCost, so
+            # the parquet tier's note_storage_read bills reads (find/get)
+            # to the calling app — ingest's "who costs what" half
+            with request_cost(
+                f"app:{auth.app_id}",
+                _cost_route(req.path),
+                "ingest",
+                ledger=costs,
+            ):
+                return handler(req, auth)
 
         return wrapped
 

@@ -550,6 +550,20 @@ class MicroBatcher:
                     # factor-cache hits in this wave: a repeat entity whose
                     # gather was skipped (flight entries prove gather ~ 0)
                     meta["cache_hits"] = timeline.cache_hits
+                if timeline.cache_misses:
+                    # ... and the misses with their fetch bytes — the cost
+                    # ledger's hit-vs-miss billing split (obs/costs.py)
+                    meta["cache_misses"] = timeline.cache_misses
+                    if timeline.cache_miss_bytes:
+                        meta["cache_miss_bytes"] = round(
+                            timeline.cache_miss_bytes, 1
+                        )
+                if timeline.storage_bytes:
+                    # event-store bytes the wave's handler read (history
+                    # gathers): prorated to members by the cost ledger
+                    meta["wave_storage_bytes"] = round(
+                        timeline.storage_bytes, 1
+                    )
                 meta["wave_size"] = len(live)
                 meta["wave_seq"] = wave_seq
                 meta["wave_request_ids"] = rids
@@ -665,6 +679,9 @@ class MicroBatcher:
         if ftl.device == "host" and dtl.device != "host":
             ftl.device = dtl.device
         ftl.cache_hits += dtl.cache_hits
+        ftl.cache_misses += dtl.cache_misses
+        ftl.cache_miss_bytes += dtl.cache_miss_bytes
+        ftl.storage_bytes += dtl.storage_bytes
         if not ftl.shards:
             ftl.shards = dtl.shards
         if not ftl.shard_seconds:
@@ -775,6 +792,16 @@ class MicroBatcher:
                     meta["wave_shard_seconds"] = timeline.shard_seconds
                 if timeline.cache_hits:
                     meta["cache_hits"] = timeline.cache_hits
+                if timeline.cache_misses:
+                    meta["cache_misses"] = timeline.cache_misses
+                    if timeline.cache_miss_bytes:
+                        meta["cache_miss_bytes"] = round(
+                            timeline.cache_miss_bytes, 1
+                        )
+                if timeline.storage_bytes:
+                    meta["wave_storage_bytes"] = round(
+                        timeline.storage_bytes, 1
+                    )
                 meta["wave_size"] = 1
                 meta["wave_seq"] = wave_seq
                 meta["solo_retry"] = True

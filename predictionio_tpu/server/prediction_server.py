@@ -44,6 +44,11 @@ from predictionio_tpu.lifecycle.generations import (
     GenerationStore,
 )
 from predictionio_tpu.obs import device as device_obs
+from predictionio_tpu.obs.costs import (
+    CostLedger,
+    default_ledger,
+    request_cost,
+)
 from predictionio_tpu.obs.disttrace import note_wave_events
 from predictionio_tpu.obs.flight import annotate
 from predictionio_tpu.obs.hotpath import (
@@ -563,6 +568,10 @@ def create_prediction_server_app(
     incidents: "IncidentRecorder | None" = None,
     #: start the evaluator's daemon thread (tests drive tick() directly)
     alerts_autostart: bool = True,
+    #: per-app cost ledger (who costs what, docs/observability.md): None =
+    #: the process default on the default registry, the same single-VM
+    #: sharing contract as ``quality``
+    costs: "CostLedger | None" = None,
 ) -> HTTPApp:
     import os
 
@@ -601,6 +610,21 @@ def create_prediction_server_app(
         )
     variant_label = (
         getattr(deployed.instance, "engine_variant", None) or "default"
+    )
+    # cost-ledger identity: bills key on (app, route, variant); the "app"
+    # a prediction server serves is its engine (PIO_COST_APP overrides for
+    # multi-replica fleets that want per-tenant names)
+    if costs is None:
+        costs = (
+            default_ledger()
+            if registry is REGISTRY
+            else CostLedger(registry=registry)
+        )
+    app.costs = costs
+    cost_app = os.environ.get("PIO_COST_APP") or str(
+        getattr(deployed.instance, "engine_factory", None)
+        or getattr(deployed.instance, "engine_id", None)
+        or "engine"
     )
 
     # -- model lifecycle: generation manifest + canary + controller ----------
@@ -715,6 +739,7 @@ def create_prediction_server_app(
         hotpath=hotpath,
         alerts=alerts,
         incidents=incidents,
+        costs=costs,
     )
     # the evaluator daemon starts when a server actually starts serving
     # (AppServer/AsyncAppServer honor this flag), NOT at app construction:
@@ -1104,11 +1129,17 @@ def create_prediction_server_app(
                 # bounded queue: shed instead of letting the backlog grow —
                 # clients get an honest 503 + Retry-After
                 _observe("/queries.json", 503, t0)
+                costs.note_shed(cost_app, "/queries.json", variant_label)
                 return shed_response(str(e), e.retry_after_s)
             except DeadlineExceeded as e:
                 # the budget ran out while queued (or mid-wave): no point
-                # answering a client that already gave up
+                # answering a client that already gave up — but the queue
+                # seconds it held were real, so they still bill
                 _observe("/queries.json", 504, t0)
+                costs.bill_meta(
+                    cost_app, "/queries.json", variant_label, meta,
+                    queue_only=True,
+                )
                 return error_response(504, f"deadline exceeded: {e}")
             except Exception as e:
                 log.exception("query serving failed")
@@ -1119,6 +1150,13 @@ def create_prediction_server_app(
                     annotate(**meta)
             instance_id, answered_variant = route_info or (
                 deployed.instance.id, variant_label,
+            )
+            # bill the prorated wave share to (app, route, variant) — every
+            # answered status, 400/500 included: the wave computed for this
+            # member either way, and conservation (ledger sums == aggregate
+            # device counters) only holds if every share lands somewhere
+            costs.bill_meta(
+                cost_app, "/queries.json", answered_variant, meta
             )
             def _stamped(resp: Response) -> Response:
                 resp.headers[INSTANCE_HEADER] = instance_id
@@ -1176,6 +1214,15 @@ def create_prediction_server_app(
 
         @app.route("POST", "/queries\\.json")
         def queries(req: Request) -> Response:
+            # the whole solo path runs on this thread, so one bound
+            # RequestCost catches its storage reads directly; the predict
+            # window's measured device time + XLA cost bill on exit
+            with request_cost(
+                cost_app, "/queries.json", variant_label, ledger=costs
+            ) as cost_rec:
+                return _solo_query(req, cost_rec)
+
+        def _solo_query(req: Request, cost_rec) -> Response:
             t0 = time.perf_counter()
             clock = StageClock()
 
@@ -1200,6 +1247,7 @@ def create_prediction_server_app(
             binding = deployed.binding_for_entity(
                 deployed.payload_entity(payload)
             )
+            cost_rec.variant = deployed.binding_label(binding)
             clock.lap("route")
             try:
                 with deployed.serving_slot(binding), degraded_scope() as degraded:
@@ -1207,10 +1255,29 @@ def create_prediction_server_app(
                     # (supplement's host_gather, any device h2d/compute/d2h)
                     # so the predict window splits into named stages; the
                     # unattributed interior is "dispatch"
-                    with device_obs.wave_timeline() as timeline:
-                        query, prediction = deployed.predict_bound(
-                            binding, query
+                    timeline = None
+                    t_pred = time.perf_counter()
+                    try:
+                        with device_obs.wave_timeline() as timeline:
+                            query, prediction = deployed.predict_bound(
+                                binding, query
+                            )
+                    finally:
+                        # solo device_s is the predict window — the same
+                        # bracket the MicroBatcher's wave device_s draws
+                        # around batch_fn (billed on error paths too: the
+                        # compute happened)
+                        cost_rec.add(
+                            device_s=time.perf_counter() - t_pred
                         )
+                        if timeline is not None:
+                            cost_rec.add(
+                                flops=timeline.flops,
+                                hbm_bytes=timeline.bytes,
+                                storage_bytes=timeline.storage_bytes,
+                                cache_hits=timeline.cache_hits,
+                                cache_misses=timeline.cache_misses,
+                            )
             except DeadlineExceeded as e:
                 _observe("/queries.json", 504, t0)
                 return _stamped(

@@ -355,6 +355,13 @@ def _render_eventstore_status(st: dict) -> None:
     lag = st.get("watermark_lag_s")
     if lag is not None:
         print(f"watermark lag: {lag:.1f}s")
+    vis = st.get("visibility") or {}
+    if vis.get("rows_observed"):
+        print(
+            f"visibility lag: p50={vis.get('lag_p50_s', 0):.1f}s "
+            f"p99={vis.get('lag_p99_s', 0):.1f}s "
+            f"(rows observed {vis['rows_observed']:,})"
+        )
     for a in st.get("apps", []):
         if a.get("error"):
             print(f"  app {a.get('app_id')}: ERROR {a['error']}")
@@ -1248,6 +1255,168 @@ def do_alerts(args) -> int:
     if not args.watch and firing_seen and firing_seen[0]:
         return 1
     return 0
+
+
+def do_costs(args) -> int:
+    """`pio costs`: the per-app cost ledger — who costs what.
+
+    With ``--url``, reads a running server's ``/costs.json`` (a fleet
+    router answers with every replica's rows, replica-tagged, plus
+    fleet-wide merged sums); without it, dumps this process's default
+    ledger.  ``--window N`` limits the closed windows included.
+    """
+
+    def render_once() -> None:
+        from predictionio_tpu.obs.costs import (
+            default_ledger,
+            render_costs_text,
+        )
+
+        if args.url:
+            path = "/costs.json"
+            if args.window is not None:
+                path += f"?windows={int(args.window)}"
+            doc = json.loads(
+                _fetch_url(
+                    args.url.rstrip("/") + path,
+                    getattr(args, "access_key", None),
+                )
+            )
+        else:
+            doc = default_ledger().snapshot(windows=args.window)
+        print(
+            json.dumps(doc, indent=2) if args.json else render_costs_text(doc)
+        )
+
+    return _run_watched("pio costs", render_once, args.watch, args.watch_count)
+
+
+def _render_top(
+    costs_doc: dict, alerts_doc: dict, metrics_doc: dict | None
+) -> str:
+    """One `pio top` frame: fleet header, request latency, alerts, and the
+    top apps by attributed device time."""
+    lines: list[str] = []
+    replicas = costs_doc.get("replicas")
+    lines.append(
+        f"fleet: {len(replicas)} replica(s) — " + ", ".join(replicas)
+        if replicas
+        else "single replica"
+    )
+    for rid, err in sorted(
+        (costs_doc.get("source_errors") or {}).items()
+    ):
+        lines.append(f"  ! {rid}: {err}")
+
+    # request rate + latency from /metrics.json when the scrape offers it
+    # (a router's federated /metrics is text, so the fleet view leans on
+    # the ledger's own open-window request counts instead)
+    if metrics_doc:
+        fam = metrics_doc.get("pio_request_latency_seconds")
+        if isinstance(fam, dict):
+            total = p50 = p99 = 0.0
+            for s in fam.get("series") or ():
+                c = float(s.get("count") or 0.0)
+                if c <= 0:
+                    continue
+                total += c
+                p50 = max(p50, float(s.get("p50") or 0.0))
+                p99 = max(p99, float(s.get("p99") or 0.0))
+            if total:
+                lines.append(
+                    f"requests: {int(total)} total   "
+                    f"p50 {p50 * 1e3:.2f} ms   p99 {p99 * 1e3:.2f} ms"
+                )
+        util = metrics_doc.get("pio_device_duty_cycle") or {}
+        for s in util.get("series") or ():
+            lines.append(f"device duty cycle: {float(s.get('value', 0)):.1%}")
+
+    firing = int(alerts_doc.get("firing") or 0)
+    pending = int(alerts_doc.get("pending") or 0)
+    lines.append(f"alerts: {firing} firing, {pending} pending")
+    for a in alerts_doc.get("alerts") or ():
+        if a.get("state") == "firing":
+            tag = f"@{a['replica']}" if a.get("replica") else ""
+            lines.append(
+                f"  ▲ {a.get('rule')}{tag} {a.get('key', '')} "
+                f"value={a.get('value')}"
+            )
+
+    # top apps by device-seconds: the open+closed totals, heaviest first
+    # (a federated body carries replica-tagged rows)
+    lines.append("")
+    lines.append(
+        f"{'APP':<20} {'ROUTE':<18} {'REQS':>8} {'DEVICE_S':>10} "
+        f"{'STORAGE':>10} {'QUEUE_S':>8} {'SHEDS':>6}"
+    )
+    rows = (costs_doc.get("totals") or [])[:15]
+    if not rows:
+        lines.append("(no attributed cost yet)")
+    for row in rows:
+        app = str(row.get("app", "?"))
+        if row.get("replica"):
+            app = f"{app}@{row['replica']}"
+        storage = float(row.get("storage_bytes", 0.0))
+        for unit in ("B", "KiB", "MiB", "GiB"):
+            if storage < 1024 or unit == "GiB":
+                break
+            storage /= 1024.0
+        lines.append(
+            f"{app:<20.20} {str(row.get('route', '')):<18.18} "
+            f"{int(row.get('requests', 0)):>8} "
+            f"{float(row.get('device_s', 0.0)):>10.4f} "
+            f"{storage:>9.1f}{unit} "
+            f"{float(row.get('queue_s', 0.0)):>8.3f} "
+            f"{int(row.get('sheds', 0)):>6}"
+        )
+    return "\n".join(lines)
+
+
+def do_top(args) -> int:
+    """`pio top`: a live terminal view of who costs what — fleet-federated
+    when ``--url`` points at a router (replica-tagged rows), single-replica
+    against a plain server, and this process's own ledger without a URL.
+    Refreshes every ``--watch`` seconds (default 2)."""
+
+    def render_once() -> None:
+        if args.url:
+            base = args.url.rstrip("/")
+            key = getattr(args, "access_key", None)
+            costs_doc = json.loads(_fetch_url(base + "/costs.json", key))
+            try:
+                alerts_doc = json.loads(
+                    _fetch_url(base + "/alerts.json", key)
+                )
+            except Exception:
+                alerts_doc = {}  # no evaluator on this server: degrade
+            try:
+                metrics_doc = json.loads(
+                    _fetch_url(base + "/metrics.json", key)
+                )
+            except Exception:
+                metrics_doc = None
+        else:
+            from predictionio_tpu.obs.costs import default_ledger
+            from predictionio_tpu.obs.metrics import REGISTRY
+
+            costs_doc = default_ledger().snapshot()
+            alerts_doc = {}
+            metrics_doc = REGISTRY.render_json()
+        if args.json:
+            print(
+                json.dumps(
+                    {"costs": costs_doc, "alerts": alerts_doc}, indent=2
+                )
+            )
+        else:
+            if sys.stdout.isatty() and args.watch:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear between frames
+            print(_render_top(costs_doc, alerts_doc, metrics_doc))
+
+    watch = args.watch if args.watch is not None else 2.0
+    if getattr(args, "once", False):
+        watch = None
+    return _run_watched("pio top", render_once, watch, args.watch_count)
 
 
 def do_incident(args) -> int:
@@ -2439,6 +2608,86 @@ def build_parser() -> argparse.ArgumentParser:
         help=argparse.SUPPRESS,  # bounded --watch iterations (tests)
     )
     al.set_defaults(fn=do_alerts)
+
+    co = sub.add_parser(
+        "costs",
+        description="Per-app cost ledger: attributed device-seconds, "
+        "flops, HBM/storage bytes, queue-seconds, and sheds by "
+        "(app, route, variant) — from a running server's /costs.json "
+        "(a fleet router answers fleet-wide, replica-tagged) or this "
+        "process's ledger.",
+    )
+    co.add_argument(
+        "--url", help="read a running server (e.g. http://127.0.0.1:8000)"
+    )
+    co.add_argument(
+        "--json", action="store_true",
+        help="raw /costs.json instead of the text table",
+    )
+    co.add_argument(
+        "--window",
+        type=int,
+        default=None,
+        metavar="N",
+        help="include only the last N closed accounting windows",
+    )
+    co.add_argument(
+        "--access-key",
+        default=None,
+        help="access key for key-gated servers (sent as a Bearer header)",
+    )
+    co.add_argument(
+        "--watch",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="re-render every SECONDS until interrupted",
+    )
+    co.add_argument(
+        "--watch-count",
+        type=int,
+        default=None,
+        help=argparse.SUPPRESS,  # bounded --watch iterations (tests)
+    )
+    co.set_defaults(fn=do_costs)
+
+    tp = sub.add_parser(
+        "top",
+        description="Live fleet view: request latency, firing alerts, and "
+        "the top apps by attributed device time — federated when --url "
+        "points at a fleet router, single-replica otherwise.  Refreshes "
+        "every --watch seconds (default 2); --once renders one frame.",
+    )
+    tp.add_argument(
+        "--url", help="read a running server (e.g. http://127.0.0.1:8000)"
+    )
+    tp.add_argument(
+        "--json", action="store_true",
+        help="raw JSON frames instead of the terminal view",
+    )
+    tp.add_argument(
+        "--once", action="store_true",
+        help="render one frame and exit (scripts/tests)",
+    )
+    tp.add_argument(
+        "--access-key",
+        default=None,
+        help="access key for key-gated servers (sent as a Bearer header)",
+    )
+    tp.add_argument(
+        "--watch",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="refresh interval (default 2)",
+    )
+    tp.add_argument(
+        "--watch-count",
+        type=int,
+        default=None,
+        help=argparse.SUPPRESS,  # bounded --watch iterations (tests)
+    )
+    tp.set_defaults(fn=do_top)
 
     ic = sub.add_parser(
         "incident",

@@ -1,0 +1,28 @@
+"""Fixture: PIO-OBS005 — route dispatch bypassing request middleware."""
+import time
+
+from server.httpd import observe_request
+from server.obs_http import record_request_outcome
+
+
+def raw_dispatch(app, req):
+    return app.handle(req)  # line 9: OBS005 (dark route, no middleware)
+
+
+def wrapped_dispatch(app, req):
+    # clean: the middleware receives the bound method as a reference —
+    # app.handle is an argument, not a call
+    return observe_request(app, req, app.handle)
+
+
+def timed_dispatch(app, req, span):
+    t0 = time.perf_counter()
+    resp = app.handle(req)  # clean: outcome recorded below
+    record_request_outcome(app, req, resp, time.perf_counter() - t0, span)
+    return resp
+
+
+def admin_shortcut(app, req):
+    if req.path == "/admin/reload":
+        return app.router.handle(req)  # line 27: OBS005 (nested receiver)
+    return None

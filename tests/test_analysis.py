@@ -134,6 +134,18 @@ class TestRuleCorpus:
         src = (FIXTURES / "res003_storage_write.py").read_text()
         assert analyze_source(src, "some_module.py") == []
 
+    def test_obs005_dispatch_bypasses_middleware(self):
+        assert triples("obs005_server_dispatch.py") == [
+            ("PIO-OBS005", 9, "medium"),
+            ("PIO-OBS005", 27, "medium"),
+        ]
+
+    def test_obs005_scoped_to_server_modules(self):
+        """The same .handle() call OUTSIDE a server-pathed module (e.g. a
+        batch tool's own dispatcher) is not an HTTP request path."""
+        src = (FIXTURES / "obs005_server_dispatch.py").read_text()
+        assert analyze_source(src, "some_module.py") == []
+
     def test_every_shipped_rule_has_fixture_coverage(self):
         """The corpus exercises every registered AST rule."""
         seen = {
@@ -153,6 +165,7 @@ class TestRuleCorpus:
                 "res002_swallow.py",
                 "res003_storage_write.py",
                 "res004_storage_full_read.py",
+                "obs005_server_dispatch.py",
             )
             for f in findings_for(name)
         }

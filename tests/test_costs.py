@@ -1,0 +1,514 @@
+"""Cost attribution: the request-scoped accounting seam, the windowed
+per-app ledger (conservation under 16 concurrent billers, SIGKILL crash
+reload), /costs.json federation across replicas, the ``costs.*`` alert
+selectors (cost_skew firing exactly once on a synthetic noisy app), and
+event-to-visible freshness lag with its ``freshness_lag`` alert rule.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import types
+from pathlib import Path
+
+import pytest
+
+from predictionio_tpu.obs.alerts import (
+    AlertEvaluator,
+    AlertRule,
+    default_rule_pack,
+)
+from predictionio_tpu.obs.costs import (
+    COST_FIELDS,
+    CostLedger,
+    RequestCost,
+    current_cost,
+    note_storage_read,
+    prorated_from_meta,
+    render_costs_text,
+    request_cost,
+)
+from predictionio_tpu.obs.metrics import MetricsRegistry
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+class Clock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# request-scoped accounting
+
+
+class TestRequestCost:
+    def test_context_bills_on_exit(self):
+        reg = MetricsRegistry()
+        led = CostLedger(registry=reg)
+        with request_cost("app:a", "/queries.json", "v1", ledger=led) as rec:
+            rec.add(device_s=0.25, storage_bytes=100.0)
+            assert current_cost() is rec
+        assert current_cost() is None
+        row = led.snapshot()["totals"][0]
+        assert (row["app"], row["route"], row["variant"]) == (
+            "app:a", "/queries.json", "v1"
+        )
+        assert row["requests"] == 1.0
+        assert row["device_s"] == pytest.approx(0.25)
+        assert row["storage_bytes"] == pytest.approx(100.0)
+
+    def test_bills_even_when_handler_raises(self):
+        led = CostLedger(registry=MetricsRegistry())
+        with pytest.raises(RuntimeError):
+            with request_cost("a", "/r", ledger=led) as rec:
+                rec.add(device_s=0.1)
+                raise RuntimeError("handler blew up")
+        assert led.snapshot()["totals"][0]["device_s"] == pytest.approx(0.1)
+
+    def test_note_storage_read_reaches_bound_request(self):
+        led = CostLedger(registry=MetricsRegistry())
+        with request_cost("a", "/r", ledger=led):
+            note_storage_read(4096)
+            note_storage_read(1024)
+        assert led.snapshot()["totals"][0]["storage_bytes"] == pytest.approx(
+            5120.0
+        )
+
+    def test_note_storage_read_without_context_is_noop(self):
+        note_storage_read(1 << 30)  # must not raise or leak anywhere
+
+    def test_unknown_field_rejected(self):
+        rec = RequestCost("a", "/r")
+        with pytest.raises(ValueError):
+            rec.add(gpu_seconds=1.0)
+
+    def test_prorated_wave_shares_sum_to_wave_totals(self):
+        meta = {
+            "wave_size": 4,
+            "device_s": 0.4,
+            "wave_flops": 400.0,
+            "wave_bytes": 800.0,
+            "wave_storage_bytes": 4000.0,
+            "queue_wait_s": 0.01,
+        }
+        share = prorated_from_meta(meta)
+        assert share["device_s"] == pytest.approx(0.1)
+        assert share["flops"] == pytest.approx(100.0)
+        assert share["hbm_bytes"] == pytest.approx(200.0)
+        assert share["storage_bytes"] == pytest.approx(1000.0)
+        # queue wait is per-member wall time, never divided by the wave
+        assert share["queue_s"] == pytest.approx(0.01)
+
+
+# ---------------------------------------------------------------------------
+# ledger conservation under concurrency
+
+
+class TestConservation:
+    def test_16_thread_sums_match_registry_within_1pct(self):
+        """Per-app ledger rollups and the aggregate pio_cost_* counters are
+        fed by the same bill call: after 16 threads hammer both through
+        window rolls, per-app sums must agree within 1%."""
+        reg = MetricsRegistry()
+        led = CostLedger(window_s=0.02, retention=100_000, registry=reg)
+        threads, per_thread = 16, 200
+        apps = [f"app:{i}" for i in range(4)]
+
+        def worker(tid: int) -> None:
+            for i in range(per_thread):
+                led.bill_values(
+                    apps[tid % 4],
+                    "/queries.json",
+                    "default",
+                    requests=1.0,
+                    device_s=0.001,
+                    storage_bytes=10.0,
+                )
+
+        ts = [
+            threading.Thread(target=worker, args=(t,)) for t in range(threads)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+        snap = led.snapshot()
+        per_app_dev = {a: 0.0 for a in apps}
+        per_app_req = {a: 0.0 for a in apps}
+        for row in snap["totals"]:
+            per_app_dev[row["app"]] += row["device_s"]
+            per_app_req[row["app"]] += row["requests"]
+        counter_dev = {a: 0.0 for a in apps}
+        for labels, c in reg.get("pio_cost_device_seconds_total").series():
+            counter_dev[labels[0]] += c.value
+
+        expected_reqs = threads // 4 * per_thread
+        for a in apps:
+            assert per_app_req[a] == pytest.approx(expected_reqs)
+            assert per_app_dev[a] == pytest.approx(
+                expected_reqs * 0.001, rel=0.01
+            )
+            assert per_app_dev[a] == pytest.approx(counter_dev[a], rel=0.01)
+
+
+# ---------------------------------------------------------------------------
+# crash-safe persistence
+
+
+class TestPersistence:
+    def test_roll_persists_and_reloads(self, tmp_path):
+        path = str(tmp_path / "costs.json")
+        clock = Clock()
+        led = CostLedger(window_s=60.0, path=path, registry=MetricsRegistry(),
+                         clock=clock)
+        led.bill_values("a", "/r", requests=1.0, device_s=0.5)
+        clock.advance(61.0)
+        led.roll()
+        doc = json.loads(Path(path).read_text())
+        assert doc["schema"] == 1 and len(doc["closed"]) == 1
+        led2 = CostLedger(window_s=60.0, path=path,
+                          registry=MetricsRegistry())
+        assert led2.snapshot()["totals"][0]["device_s"] == pytest.approx(0.5)
+
+    def test_schema_mismatch_starts_empty(self, tmp_path):
+        path = tmp_path / "costs.json"
+        path.write_text(json.dumps({"schema": 999, "closed": [{"rows": []}]}))
+        led = CostLedger(path=str(path), registry=MetricsRegistry())
+        assert led.snapshot()["windows"] == []
+
+    @pytest.mark.slow
+    def test_sigkill_loses_at_most_the_open_window(self, tmp_path):
+        """A billing process SIGKILLed mid-flight: every rolled window is
+        readable after reload; only the open (never-persisted) window may
+        be lost."""
+        path = str(tmp_path / "costs.json")
+        child = (
+            "import os, sys, time\n"
+            f"sys.path.insert(0, {str(REPO_ROOT)!r})\n"
+            "from predictionio_tpu.obs.costs import CostLedger\n"
+            f"led = CostLedger(window_s=60.0, path={path!r})\n"
+            "for i in range(5):\n"
+            "    led.bill_values('app:durable', '/events.json', 'ingest',\n"
+            "                    requests=1.0, device_s=0.01,\n"
+            "                    storage_bytes=100.0)\n"
+            "led.roll(now=time.time() + 120.0)\n"  # closes + fsyncs
+            "led.bill_values('app:doomed', '/events.json', 'ingest',\n"
+            "                requests=1.0, device_s=9.9)\n"  # open only
+            "print('READY', flush=True)\n"
+            "time.sleep(120)\n"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", child],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            env=env,
+            text=True,
+        )
+        try:
+            line = proc.stdout.readline()
+            assert line.strip() == "READY", f"child failed: {line!r}"
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        led = CostLedger(window_s=60.0, path=path,
+                         registry=MetricsRegistry())
+        snap = led.snapshot()
+        by_app = {r["app"]: r for r in snap["totals"]}
+        assert by_app["app:durable"]["requests"] == pytest.approx(5.0)
+        assert by_app["app:durable"]["storage_bytes"] == pytest.approx(500.0)
+        assert "app:doomed" not in by_app  # at most the open window is lost
+
+
+# ---------------------------------------------------------------------------
+# federation
+
+
+class TestFederation:
+    def _ledger_with(self, app: str, device_s: float) -> CostLedger:
+        led = CostLedger(registry=MetricsRegistry())
+        led.bill_values(app, "/queries.json", "default",
+                        requests=2.0, device_s=device_s)
+        return led
+
+    def test_merge_tags_replicas_and_sums_fleetwide(self):
+        from predictionio_tpu.fleet.federation import federated_costs
+
+        s1 = self._ledger_with("app:a", 3.0).snapshot()
+        s2 = self._ledger_with("app:a", 1.0).snapshot()
+        out = federated_costs(
+            {"r1": s1, "r2": s2}, {"r3": "ConnectionRefusedError: dead"}
+        )
+        assert out["fleet"] is True
+        assert out["replicas"] == ["r1", "r2"]
+        # heaviest replica-tagged row first
+        assert out["totals"][0]["replica"] == "r1"
+        assert out["totals"][0]["device_s"] == pytest.approx(3.0)
+        merged = out["merged"][0]
+        assert merged["app"] == "app:a"
+        assert merged["device_s"] == pytest.approx(4.0)
+        assert merged["requests"] == pytest.approx(4.0)
+        assert out["source_errors"] == {"r3": "ConnectionRefusedError: dead"}
+        # the renderer accepts the fleet shape (source_errors as a dict)
+        text = render_costs_text(out)
+        assert "app:a@r1" in text and "r3" in text
+
+    def test_costs_json_federates_across_two_live_replicas(self):
+        """End to end: two replica HTTPApps each serving /costs.json from
+        a real ledger, a router federating them on its own /costs.json."""
+        from predictionio_tpu.fleet.membership import FleetState
+        from predictionio_tpu.fleet.router import create_router_app
+        from predictionio_tpu.obs.http import add_observability_routes
+        from predictionio_tpu.server.httpd import (
+            AppServer,
+            HTTPApp,
+            Request,
+        )
+
+        servers = []
+        urls = []
+        for name, dev_s in (("a", 2.0), ("b", 1.0)):
+            app = HTTPApp(f"replica-{name}")
+            reg = MetricsRegistry()
+            led = CostLedger(registry=reg)
+            led.bill_values(f"app:{name}", "/queries.json", "default",
+                            requests=1.0, device_s=dev_s)
+            led.bill_values("app:shared", "/queries.json", "default",
+                            requests=1.0, device_s=0.5)
+            add_observability_routes(app, reg, costs=led)
+            srv = AppServer(app, "127.0.0.1", 0).start_background()
+            servers.append(srv)
+            urls.append(f"http://127.0.0.1:{srv.port}")
+        registry = MetricsRegistry()
+        fleet = FleetState(urls, registry=registry)
+        fleet.probe_once()
+        router = create_router_app(fleet, registry=registry)
+        try:
+            r = router.handle(Request("GET", "/costs.json", {}, {}))
+            assert r.status == 200
+            body = r.body
+            assert body["fleet"] is True and len(body["replicas"]) == 2
+            merged = {row["app"]: row for row in body["merged"]}
+            assert merged["app:shared"]["device_s"] == pytest.approx(1.0)
+            assert merged["app:shared"]["requests"] == pytest.approx(2.0)
+            assert merged["app:a"]["device_s"] == pytest.approx(2.0)
+            replicas_seen = {row["replica"] for row in body["totals"]}
+            assert len(replicas_seen) == 2
+        finally:
+            for srv in servers:
+                srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# alert selectors
+
+
+class TestCostAlerts:
+    def _skew_rule(self) -> AlertRule:
+        rules = [r for r in default_rule_pack() if r.name == "cost_skew"]
+        assert len(rules) == 1
+        return rules[0]
+
+    def test_cost_skew_fires_exactly_once_for_the_noisy_app(self):
+        clock = Clock()
+        reg = MetricsRegistry()
+        led = CostLedger(window_s=3600.0, registry=reg, clock=clock)
+        led.bill_values("app:noisy", "/queries.json",
+                        requests=90.0, device_s=0.9)
+        led.bill_values("app:quiet", "/queries.json",
+                        requests=10.0, device_s=0.1)
+        ev = AlertEvaluator(
+            registry=reg,
+            rules=[self._skew_rule()],
+            app=types.SimpleNamespace(costs=led),
+            clock=clock,
+        )
+        assert ev.tick()["pending"] == 1  # for_s hold-down
+        clock.advance(11.0)
+        counts = ev.tick()
+        assert counts["firing"] == 1  # exactly the noisy app, nobody else
+        fired = [
+            a for a in ev.snapshot()["alerts"] if a["state"] == "firing"
+        ]
+        assert len(fired) == 1 and "app:noisy" in fired[0]["key"]
+        # steady breach: still one firing instance, ONE firing transition
+        for _ in range(5):
+            clock.advance(5.0)
+            assert ev.tick()["firing"] == 1
+        fam = reg.get("pio_alerts_transitions_total")
+        firing_transitions = sum(
+            c.value for labels, c in fam.series() if labels[1] == "firing"
+        )
+        assert firing_transitions == 1
+
+    def test_device_share_silent_for_single_tenant(self):
+        led = CostLedger(registry=MetricsRegistry())
+        led.bill_values("only-app", "/r", requests=1.0, device_s=5.0)
+        assert led.signal("device_share") == {}
+
+    def test_burn_vs_budget(self):
+        clock = Clock()
+        led = CostLedger(
+            window_s=60.0,
+            budgets={"app:hot": 1.0},
+            default_budget=None,
+            registry=MetricsRegistry(),
+            clock=clock,
+        )
+        clock.advance(30.0)
+        led.bill_values("app:hot", "/r", requests=1.0, device_s=1.0)
+        led.bill_values("app:unbudgeted", "/r", requests=1.0, device_s=9.0)
+        sig = led.signal("burn_vs_budget")
+        # 1 device-second over 30 covered seconds = 2 device-s/min vs 1.0
+        assert sig["app:hot"] == pytest.approx(2.0)
+        assert "app:unbudgeted" not in sig  # no budget, no burn signal
+
+    def test_evaluator_reads_cost_signals_per_app(self):
+        clock = Clock()
+        reg = MetricsRegistry()
+        led = CostLedger(window_s=3600.0, budgets={"a": 0.001},
+                         default_budget=None, registry=reg, clock=clock)
+        clock.advance(60.0)
+        led.bill_values("a", "/r", requests=1.0, device_s=10.0)
+        rule = AlertRule("cost_burn", "costs.burn_vs_budget", 1.0)
+        ev = AlertEvaluator(
+            registry=reg, rules=[rule],
+            app=types.SimpleNamespace(costs=led), clock=clock,
+        )
+        assert ev.tick()["firing"] == 1
+
+
+# ---------------------------------------------------------------------------
+# event-to-visible freshness
+
+
+class TestFreshness:
+    def test_compaction_observes_row_weighted_visibility_lag(self, tmp_path):
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage.parquet_backend import (
+            ParquetClient,
+            ParquetEventStore,
+            _metrics,
+        )
+
+        h = _metrics()["visibility_lag"]
+        before = h.count
+        client = ParquetClient(tmp_path / "events")
+        store = ParquetEventStore(client)
+        evs = [
+            Event(event="rate", entity_type="user", entity_id=str(i),
+                  target_entity_type="item", target_entity_id="1",
+                  properties={"rating": 4.0})
+            for i in range(40)
+        ]
+        store.append_events(evs, 1, None)
+        time.sleep(0.02)
+        store.compact(1)
+        assert h.count - before >= 40  # row-weighted, not per-segment
+        p99 = _metrics()["visibility_lag_p99"].value
+        assert 0.0 < p99 < 60.0  # sane: seconds-old hot head, not garbage
+
+    def test_compactor_status_exposes_visibility_block(self, tmp_path):
+        from predictionio_tpu.data.event import Event
+        from predictionio_tpu.data.storage.compactor import (
+            CompactionPolicy,
+            Compactor,
+        )
+        from predictionio_tpu.data.storage.parquet_backend import (
+            ParquetClient,
+            ParquetEventStore,
+        )
+
+        client = ParquetClient(tmp_path / "events")
+        store = ParquetEventStore(client)
+        store.append_events(
+            [Event(event="e", entity_type="u", entity_id="1")], 1, None
+        )
+        store.compact(1)
+        st = Compactor(client, CompactionPolicy()).status()
+        vis = st["visibility"]
+        assert vis["rows_observed"] >= 1
+        assert vis["lag_p50_s"] is not None and vis["lag_p99_s"] is not None
+
+    def test_freshness_lag_alert_fires_under_stall_and_clears(self):
+        rules = [
+            r for r in default_rule_pack() if r.name == "freshness_lag"
+        ]
+        assert len(rules) == 1
+        clock = Clock()
+        reg = MetricsRegistry()
+        g = reg.gauge(
+            "pio_event_visibility_lag_p99_seconds",
+            "p99 visibility lag (test twin)",
+        )
+        ev = AlertEvaluator(registry=reg, rules=rules, clock=clock)
+        g.set(5.0)  # healthy compactor
+        assert ev.tick()["firing"] == 0
+        g.set(300.0)  # induced stall: events sit hot for five minutes
+        ev.tick()
+        clock.advance(16.0)
+        assert ev.tick()["firing"] == 1
+        g.set(55.0)  # inside the clear band: flap resistance holds it
+        clock.advance(5.0)
+        assert ev.tick()["firing"] == 1
+        g.set(5.0)  # genuinely recovered
+        clock.advance(5.0)
+        assert ev.tick()["firing"] == 0
+
+
+# ---------------------------------------------------------------------------
+# rendering + snapshot shape
+
+
+class TestSnapshotAndRender:
+    def test_windows_param_limits_closed_windows(self):
+        clock = Clock()
+        led = CostLedger(window_s=10.0, registry=MetricsRegistry(),
+                         clock=clock)
+        for _ in range(3):
+            led.bill_values("a", "/r", requests=1.0, device_s=0.1)
+            clock.advance(11.0)
+        snap = led.snapshot(windows=1)
+        assert len(snap["windows"]) == 1
+        # totals follow the selection: a recent-cost view, not all-time
+        assert snap["totals"][0]["requests"] == pytest.approx(1.0)
+        assert led.snapshot()["totals"][0]["requests"] == pytest.approx(3.0)
+
+    def test_render_single_replica_text(self):
+        led = CostLedger(registry=MetricsRegistry())
+        led.bill_values("app:a", "/queries.json", "default",
+                        requests=3.0, device_s=0.5, storage_bytes=2048.0)
+        text = render_costs_text(led.snapshot())
+        assert "app:a" in text and "/queries.json" in text
+        assert "2.0KiB" in text
+
+    def test_cost_fields_cover_the_registry_mirror(self):
+        reg = MetricsRegistry()
+        CostLedger(registry=reg)
+        for field, metric in (
+            ("requests", "pio_cost_requests_total"),
+            ("device_s", "pio_cost_device_seconds_total"),
+            ("flops", "pio_cost_flops_total"),
+            ("hbm_bytes", "pio_cost_hbm_bytes_total"),
+            ("storage_bytes", "pio_cost_storage_bytes_total"),
+            ("queue_s", "pio_cost_queue_seconds_total"),
+            ("sheds", "pio_cost_sheds_total"),
+        ):
+            assert field in COST_FIELDS
+            assert reg.get(metric) is not None
