@@ -8,6 +8,10 @@ families:
                   Python branches in @jit, recompile hazards (rules_jax)
   - PIO-CONC00x — blocking calls in async handlers, busy-wait polls,
                   unlocked mutation of lock-guarded state (rules_concurrency)
+  - PIO-LOCK00x — whole-program lock-order inversions and blocking calls
+                  held under a lock, over the call/lock graph built by
+                  callgraph.py (rules_locks); PIO-JAX008 rides the same
+                  graph for transitive hot-path syncs
   - PIO-RES00x  — network calls without timeouts, silent exception
                   swallowing on serving hot paths (rules_resilience)
   - PIO-OBS00x  — route dispatch that bypasses the request-latency
@@ -27,6 +31,7 @@ from predictionio_tpu.analysis.analyzer import (  # noqa: F401
     analyze_source,
     filter_severity,
     render_json,
+    render_sarif,
     render_text,
 )
 from predictionio_tpu.analysis.baseline import (  # noqa: F401
@@ -34,12 +39,21 @@ from predictionio_tpu.analysis.baseline import (  # noqa: F401
     Baseline,
     BaselineError,
 )
+from predictionio_tpu.analysis.callgraph import (  # noqa: F401
+    Program,
+    build_program,
+)
 from predictionio_tpu.analysis.findings import Finding, Severity  # noqa: F401
-from predictionio_tpu.analysis.rules import ALL_RULES, Rule  # noqa: F401
+from predictionio_tpu.analysis.rules import (  # noqa: F401
+    ALL_RULES,
+    ProgramRule,
+    Rule,
+)
 
 # importing the rule modules registers them in ALL_RULES
 from predictionio_tpu.analysis import rules_concurrency  # noqa: E402,F401
 from predictionio_tpu.analysis import rules_jax  # noqa: E402,F401
+from predictionio_tpu.analysis import rules_locks  # noqa: E402,F401
 from predictionio_tpu.analysis import rules_obs  # noqa: E402,F401
 from predictionio_tpu.analysis import rules_resilience  # noqa: E402,F401
 
@@ -50,11 +64,15 @@ __all__ = [
     "BaselineError",
     "DEFAULT_BASELINE_NAME",
     "Finding",
+    "Program",
+    "ProgramRule",
     "Rule",
     "Severity",
     "analyze_paths",
     "analyze_source",
+    "build_program",
     "filter_severity",
     "render_json",
+    "render_sarif",
     "render_text",
 ]

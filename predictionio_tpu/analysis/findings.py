@@ -71,3 +71,16 @@ class Finding:
             "message": self.message,
             "source": self.source,
         }
+
+    @classmethod
+    def from_json_dict(cls, d: dict[str, Any]) -> "Finding":
+        """Inverse of :meth:`to_json_dict` (check-cache round trips)."""
+        return cls(
+            rule=str(d["rule"]),
+            severity=Severity.parse(str(d["severity"])),
+            file=str(d["file"]),
+            line=int(d["line"]),
+            col=int(d["col"]),
+            message=str(d["message"]),
+            source=str(d.get("source", "")),
+        )

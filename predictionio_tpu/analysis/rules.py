@@ -234,6 +234,24 @@ class Rule(abc.ABC):
         )
 
 
+class ProgramRule(Rule):
+    """Whole-program rule: runs once over every parsed module at a time.
+
+    Per-module ``check`` is a no-op; the analyzer calls ``check_program``
+    with a :class:`predictionio_tpu.analysis.callgraph.Program` built from
+    all modules in the scan.  Findings still carry a per-file ``rel`` path,
+    so pragma and baseline suppression work unchanged.  Note the scan scope
+    IS the analysis scope: running a program rule on a single file cannot
+    see edges into other modules.
+    """
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        return ()
+
+    @abc.abstractmethod
+    def check_program(self, program) -> Iterable[Finding]: ...
+
+
 #: id -> rule instance; populated by the @rule decorator at import time
 ALL_RULES: dict[str, Rule] = {}
 

@@ -255,11 +255,19 @@ class UnlockedGuardedMutation(Rule):
         """(attr, node, under_lock) for every self.<attr> mutation in fn."""
         for node in walk_skipping_defs(fn.body):
             attrs: list[str] = []
-            if isinstance(node, (ast.Assign, ast.AugAssign)):
-                targets = (
-                    node.targets if isinstance(node, ast.Assign) else [node.target]
-                )
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                else:  # AugAssign / AnnAssign: one target
+                    # a bare annotation (`self.x: int`) binds nothing
+                    if isinstance(node, ast.AnnAssign) and node.value is None:
+                        continue
+                    targets = [node.target]
                 for tgt in targets:
+                    attrs.extend(_target_attrs(tgt))
+            elif isinstance(node, ast.Delete):
+                # del self.d[k] mutates the guarded container too
+                for tgt in node.targets:
                     attrs.extend(_target_attrs(tgt))
             elif isinstance(node, ast.Call) and isinstance(
                 node.func, ast.Attribute
@@ -306,8 +314,9 @@ def _target_attrs(tgt: ast.AST):
 
 
 def _self_attr_target(tgt: ast.AST) -> str | None:
-    """'x' for self.x / self.x[...] targets, else None."""
-    if isinstance(tgt, ast.Subscript):
+    """'x' for self.x / self.x[...] / self.x[...][...] targets, else None
+    (nested subscript chains unwrap to the root attribute)."""
+    while isinstance(tgt, ast.Subscript):
         tgt = tgt.value
     if (
         isinstance(tgt, ast.Attribute)

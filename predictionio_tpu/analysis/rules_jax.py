@@ -16,6 +16,7 @@ from typing import Iterable, Iterator
 from predictionio_tpu.analysis.findings import Finding, Severity
 from predictionio_tpu.analysis.rules import (
     ModuleInfo,
+    ProgramRule,
     Rule,
     ancestors,
     jit_decorator_info,
@@ -399,6 +400,96 @@ class DispatchRegionSync(Rule):
                         f"function {fn.name!r} synchronizes before the "
                         "fence; the dispatch half must stay non-blocking",
                     )
+
+
+#: bounded call depth for the transitive hot-path walk; deep enough to see
+#: "predict -> _gather -> _pull", shallow enough that utility plumbing far
+#: from the seam does not drown the report
+JAX008_MAX_DEPTH = 4
+
+#: canonical sync spellings checked transitively.  numpy.asarray/array are
+#: deliberately NOT here: two calls below the seam the receiver type is
+#: unknowable, and on host lists they are the normal gather idiom (JAX001
+#: still flags them inside the hot function itself, where context is local).
+_TRANSITIVE_SYNC_CALLS = frozenset(
+    ("jax.device_get", "jax.block_until_ready")
+)
+
+
+def _transitive_sync_label(mod: ModuleInfo, node: ast.Call) -> str | None:
+    if isinstance(node.func, ast.Attribute):
+        if node.func.attr == "item" and not node.args:
+            return "*.item()"
+        if node.func.attr == "block_until_ready":
+            return "*.block_until_ready()"
+    callee = resolve_call(mod, node)
+    if callee in _TRANSITIVE_SYNC_CALLS:
+        return callee
+    return None
+
+
+@rule
+class TransitiveHotPathSync(ProgramRule):
+    """PIO-JAX008: host sync in a helper *reachable* from a serving seam.
+
+    JAX001/JAX007 are local — they see syncs written directly inside
+    predict/batch_fn/dispatch_* bodies.  This rule walks the call graph
+    from those seams (bounded depth) and re-runs the sync set over every
+    reached helper, so a ``.item()`` two calls below ``predict`` no longer
+    hides.
+    """
+
+    id = "PIO-JAX008"
+    severity = Severity.MEDIUM
+    summary = (
+        "host sync (.item()/device_get/block_until_ready) in a helper "
+        "reachable from a hot-path function; the stall hides below the "
+        "serving seam"
+    )
+
+    def check_program(self, program) -> Iterable[Finding]:
+        roots = sorted(
+            q
+            for q, fi in program.functions.items()
+            if _is_hot_function(fi.node) or _DISPATCH_FRAGMENT in fi.name
+        )
+        reach = program.reachable(roots, JAX008_MAX_DEPTH)
+        seen: set[tuple[str, int]] = set()
+        for q in sorted(reach):
+            chain = reach[q]
+            if not chain:
+                continue  # a seam itself: JAX001/JAX007 territory
+            fi = program.functions[q]
+            if _is_hot_function(fi.node) or _DISPATCH_FRAGMENT in fi.name:
+                continue  # local rules already watch these by name
+            mod = fi.mod
+            for node in walk_skipping_defs(fi.node.body):
+                if not isinstance(node, ast.Call):
+                    continue
+                label = _transitive_sync_label(mod, node)
+                if label is None:
+                    continue
+                key = (mod.rel, node.lineno)
+                if key in seen:
+                    continue
+                seen.add(key)
+                root_fn, _, _ = chain[0]
+                via = " -> ".join(fn for fn, _, _ in chain) + f" -> {q}"
+                yield Finding(
+                    rule=self.id,
+                    severity=self.severity,
+                    file=mod.rel,
+                    line=node.lineno,
+                    col=node.col_offset + 1,
+                    message=(
+                        f"{label} in helper {fi.name!r} is reachable from "
+                        f"hot-path seam {root_fn!r} (via {via}, depth "
+                        f"{len(chain)}): the device->host sync runs once "
+                        "per query even though no hot-named function spells "
+                        "it; batch the transfer at the seam's fence instead"
+                    ),
+                    source=mod.line_text(node.lineno),
+                )
 
 
 @rule

@@ -23,16 +23,170 @@ a thread-local re-entrancy guard lets the metrics registry instrument its
 OWN lock: resolving the lock metrics walks the registry, which acquires
 the registry lock; a resolution already in flight on this thread skips the
 observation instead of deadlocking on itself.
+
+:class:`LockWitness` is the runtime half of the static lock-order analysis
+(``analysis/callgraph.py`` + PIO-LOCK001): with ``PIO_LOCK_WITNESS=1`` (or
+:func:`enable_witness`), every ContendedLock acquisition records the
+per-thread held-lock stack, accumulates the executed "held A, acquired B"
+edge set, and flags order inversions *actually run* — counted in
+``pio_lock_order_violations_total{pair}`` and dumped (with the edge set)
+at the debug-gated ``/locks.json`` route.  A tier-1 test asserts the
+witnessed edge set is a subgraph of the static acquisition graph.  With
+the witness off (the default) the only cost on the uncontended fast path
+is one module-global load and a None check.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
 #: re-entrancy guard: True while THIS thread is resolving lock metrics
 #: through the registry (whose own lock may be a ContendedLock)
 _resolving = threading.local()
+
+#: cap on retained violation records (the counter keeps exact totals)
+_WITNESS_MAX_VIOLATIONS = 100
+
+
+class LockWitness:
+    """Runtime lock-order recorder for ContendedLock acquisitions.
+
+    Per-thread held-name stacks live in a ``threading.local``; the shared
+    edge table is guarded by a plain ``threading.Lock`` (the witness must
+    not instrument itself).  An inversion is recorded the moment an edge
+    ``(B, A)`` is executed while ``(A, B)`` was ever executed before — the
+    interleaving that deadlocks did not need to happen, only both orders.
+
+    Acquisitions made while this thread is resolving metric children
+    (``_resolving.busy``) are invisible: those are the instrumentation's
+    own registry walks, not application lock nesting.
+    """
+
+    def __init__(self) -> None:
+        self._tls = threading.local()
+        self._mu = threading.Lock()
+        self._edges: dict[tuple[str, str], int] = {}
+        self._violations: list[dict] = []
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def note_acquired(self, name: str) -> None:
+        if getattr(_resolving, "busy", False):
+            return
+        held = self._held()
+        if name in held:
+            held.append(name)  # re-entrant: no new ordering fact
+            return
+        inversions: list[tuple[str, str]] = []
+        if held:
+            with self._mu:
+                for h in dict.fromkeys(held):
+                    pair = (h, name)
+                    self._edges[pair] = self._edges.get(pair, 0) + 1
+                    if (name, h) in self._edges:
+                        inversions.append(pair)
+                        if len(self._violations) < _WITNESS_MAX_VIOLATIONS:
+                            self._violations.append(
+                                {
+                                    "pair": "|".join(sorted((h, name))),
+                                    "held": h,
+                                    "acquired": name,
+                                    "stack": list(held) + [name],
+                                    "thread": threading.current_thread().name,
+                                }
+                            )
+        held.append(name)
+        for pair in inversions:
+            self._count_violation(pair)
+
+    def note_released(self, name: str) -> None:
+        if getattr(_resolving, "busy", False):
+            return
+        held = getattr(self._tls, "held", None)
+        if not held:
+            return
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+    def _count_violation(self, pair: tuple[str, str]) -> None:
+        """Bump the violations counter OUTSIDE the witness mutex, with the
+        metrics-resolution guard set so the registry walk (which acquires
+        the registry's own ContendedLock) is not witnessed as more edges."""
+        if getattr(_resolving, "busy", False):
+            return
+        _resolving.busy = True
+        try:
+            from predictionio_tpu.obs.metrics import REGISTRY
+
+            REGISTRY.counter(
+                "pio_lock_order_violations_total",
+                "Runtime lock-order inversions observed by the LockWitness",
+                labelnames=("pair",),
+            ).labels("|".join(sorted(pair))).inc()
+        except Exception:
+            pass  # telemetry must never take the serving path down
+        finally:
+            _resolving.busy = False
+
+    def snapshot(self) -> dict:
+        """Edge set + retained violations (the /locks.json payload)."""
+        with self._mu:
+            edges = sorted(self._edges.items())
+            violations = list(self._violations)
+        return {
+            "enabled": True,
+            "edges": [
+                {"src": a, "dst": b, "count": n} for (a, b), n in edges
+            ],
+            "violations": violations,
+        }
+
+    def edge_set(self) -> set:
+        with self._mu:
+            return set(self._edges)
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+            self._violations.clear()
+
+
+#: process witness; installed at import when PIO_LOCK_WITNESS=1, or later
+#: via enable_witness() (tests).  Read once per acquisition — keep it a
+#: single module-global load.
+_WITNESS: LockWitness | None = (
+    LockWitness() if os.environ.get("PIO_LOCK_WITNESS") == "1" else None
+)
+
+
+def witness() -> LockWitness | None:
+    return _WITNESS
+
+
+def enable_witness() -> LockWitness:
+    global _WITNESS
+    _WITNESS = LockWitness()
+    return _WITNESS
+
+
+def disable_witness() -> None:
+    global _WITNESS
+    _WITNESS = None
+
+
+def witness_snapshot() -> dict:
+    w = _WITNESS
+    if w is None:
+        return {"enabled": False, "edges": [], "violations": []}
+    return w.snapshot()
 
 
 class ContendedLock:
@@ -107,7 +261,11 @@ class ContendedLock:
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         # uncontended fast path: one non-blocking attempt, zero telemetry —
         # histogram mass appears ONLY when an acquisition genuinely blocked
+        # (witness off: the only overhead here is one global load + is-None)
         if self._inner.acquire(False):
+            w = _WITNESS
+            if w is not None:
+                w.note_acquired(self.name)
             return True
         if not blocking:
             return False
@@ -118,9 +276,16 @@ class ContendedLock:
         if m_wait is not None:
             m_contended.inc()
             m_wait.observe(wait_s)
+        if ok:
+            w = _WITNESS
+            if w is not None:
+                w.note_acquired(self.name)
         return ok
 
     def release(self) -> None:
+        w = _WITNESS
+        if w is not None:
+            w.note_released(self.name)
         self._inner.release()
 
     def __enter__(self) -> "ContendedLock":
@@ -128,6 +293,9 @@ class ContendedLock:
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
+        w = _WITNESS
+        if w is not None:
+            w.note_released(self.name)
         self._inner.release()
 
 

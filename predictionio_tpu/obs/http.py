@@ -22,6 +22,9 @@ onto a server:
                             instances, recent transitions, the rule set
   GET  /costs.json          the per-app cost ledger: open + closed windows
                             of (app, route, variant) resource rollups
+  GET  /locks.json          runtime lock-order witness: executed lock-edge
+                            set + observed inversions (PIO_LOCK_WITNESS=1;
+                            {"enabled": false} otherwise)
   GET  /incidents.json      recorded incident bundles (newest first)
   GET  /incidents/<id>.json one full bundle (replayable by pio trace --file)
   GET  /healthz             liveness — ALWAYS ungated (load balancers carry
@@ -83,6 +86,7 @@ _OBS_PATHS = frozenset(
         "/incidents.json",
         "/costs.json",
         "/eventstore.json",
+        "/locks.json",
         "/healthz",
         "/readyz",
         "/slo.json",
@@ -389,6 +393,16 @@ def add_observability_routes(
     @route("GET", "/efficiency\\.json")
     def efficiency_json(req: Request) -> Response:
         return json_response(200, device_snapshot())
+
+    # -- runtime lock-order witness ------------------------------------------
+    # the executed lock-edge set + any order inversions seen by the
+    # LockWitness (PIO_LOCK_WITNESS=1); debug-gated like the flight
+    # recorder — held-lock stacks describe the serving program's internals
+    @route("GET", "/locks\\.json")
+    def locks_json(req: Request) -> Response:
+        from predictionio_tpu.obs.contention import witness_snapshot
+
+        return json_response(200, witness_snapshot())
 
     # -- sharded-mesh straggler scoreboard -----------------------------------
     # per-device placement attribution + the rolling straggler board: the

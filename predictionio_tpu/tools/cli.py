@@ -506,9 +506,15 @@ def _dase_preflight(factory_name: str, engine=None, skip: bool = False) -> int:
 
     Returns 0 when clean/skipped, 1 when the wiring is broken — the caller
     aborts before touching storage or devices.  ``--no-check`` skips.
+
+    With ``PIO_PREFLIGHT_LINT=1`` a full-package `pio check` scan rides
+    along as an advisory (never blocks the launch) — cheap to leave on
+    because it runs through the check-result cache: an unchanged package
+    is a pure cache hit, no re-parsing per launch.
     """
     if skip or not factory_name:
         return 0
+    _preflight_lint_advisory()
     from predictionio_tpu.analysis.contract import (
         check_engine,
         check_engine_contract,
@@ -531,6 +537,37 @@ def _dase_preflight(factory_name: str, engine=None, skip: bool = False) -> int:
         file=sys.stderr,
     )
     return 1
+
+
+def _preflight_lint_advisory() -> None:
+    """Cached advisory lint of the deployed package (PIO_PREFLIGHT_LINT=1)."""
+    if os.environ.get("PIO_PREFLIGHT_LINT") != "1":
+        return
+    try:
+        from predictionio_tpu.analysis import analyze_paths
+        from predictionio_tpu.analysis.cache import (
+            DEFAULT_CACHE_NAME,
+            CheckCache,
+        )
+        from predictionio_tpu.tools.daemon import pio_home
+
+        import predictionio_tpu as _pkg
+
+        pkg_root = Path(_pkg.__file__).parent
+        cache = CheckCache(Path(pio_home()) / DEFAULT_CACHE_NAME)
+        report = analyze_paths(
+            [pkg_root], root=pkg_root.parent, cache=cache
+        )
+        if report.findings:
+            print(
+                f"pre-flight lint (advisory): {len(report.findings)} "
+                f"finding(s) in {report.files_scanned} file(s); run "
+                "`pio check` for details "
+                f"[{cache.stats_line()}]",
+                file=sys.stderr,
+            )
+    except Exception as e:  # advisory: a lint crash must not block launch
+        print(f"pre-flight lint skipped: {e}", file=sys.stderr)
 
 
 def do_train(args) -> int:
@@ -1925,6 +1962,7 @@ def do_check(args) -> int:
         analyze_paths,
         filter_severity,
         render_json,
+        render_sarif,
         render_text,
     )
 
@@ -1939,11 +1977,30 @@ def do_check(args) -> int:
     if not paths and not engines:
         paths = ["."]
 
+    if getattr(args, "graph", False):
+        return _check_graph_dump(paths)
+
+    cache = None
+    if not getattr(args, "no_cache", False):
+        from predictionio_tpu.analysis.cache import (
+            DEFAULT_CACHE_NAME,
+            CheckCache,
+        )
+        from predictionio_tpu.tools.daemon import pio_home
+
+        cache = CheckCache(Path(pio_home()) / DEFAULT_CACHE_NAME)
+
     try:
-        report = analyze_paths(paths)  # [] (engine-only run) => empty report
+        # [] (engine-only run) => empty report
+        report = analyze_paths(paths, cache=cache)
     except FileNotFoundError as e:
         print(f"usage error: {e}", file=sys.stderr)
         return 2
+    if getattr(args, "stats", False):
+        stats = (
+            cache.stats_line() if cache is not None else "cache: disabled"
+        )
+        print(stats, file=sys.stderr)
 
     # DASE contract checks (import the named engine factories)
     if engines:
@@ -1994,11 +2051,42 @@ def do_check(args) -> int:
 
     if args.format == "json":
         _print(render_json(report))
+    elif args.format == "sarif":
+        _print(render_sarif(report))
     else:
         print(render_text(report))
     if report.errors:
         return 2
     return 1 if report.findings else 0
+
+
+def _check_graph_dump(paths) -> int:
+    """`pio check --graph`: whole-program call/lock graphs as JSON."""
+    from predictionio_tpu.analysis.analyzer import (
+        _relpath,
+        iter_python_files,
+    )
+    from predictionio_tpu.analysis.callgraph import build_program
+    from predictionio_tpu.analysis.rules import parse_module
+
+    root = Path.cwd()
+    mods = []
+    errors = []
+    try:
+        files = iter_python_files(paths)
+    except FileNotFoundError as e:
+        print(f"usage error: {e}", file=sys.stderr)
+        return 2
+    for path in files:
+        rel = _relpath(path, root)
+        try:
+            mods.append(parse_module(path, rel, path.read_text("utf-8")))
+        except (OSError, SyntaxError, ValueError) as e:
+            errors.append(f"{rel}: {type(e).__name__}: {e}")
+    _print(build_program(mods).to_json())
+    for e in errors:
+        print(f"error: {e}", file=sys.stderr)
+    return 2 if errors else 0
 
 
 def do_trace(args) -> int:
@@ -2896,7 +2984,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="also run DASE contract checks for this engine factory "
         "(repeatable; 'all' = every bundled engine)",
     )
-    ck.add_argument("--format", choices=["text", "json"], default="text")
+    ck.add_argument(
+        "--format", choices=["text", "json", "sarif"], default="text"
+    )
+    ck.add_argument(
+        "--graph",
+        action="store_true",
+        help="dump the whole-program call graph + lock acquisition graph "
+        "as JSON and exit (0, or 2 on parse errors)",
+    )
+    ck.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="bypass the check-result cache ($PIO_HOME/check-cache.json)",
+    )
+    ck.add_argument(
+        "--stats",
+        action="store_true",
+        help="print cache hit/miss counts to stderr",
+    )
     ck.add_argument(
         "--severity",
         default="low",

@@ -26,3 +26,22 @@ class Box:
     def locked_reset(self):
         with self._lock:
             self.count = 0  # clean: under the lock
+
+    def locked_slot(self, k, v):
+        with self._lock:
+            self.table[k] = v  # guards self.table (subscript write counts)
+
+    def sneaky_bump(self):
+        self.count += 1  # line 35: CONC003 (aug-assign blind spot)
+
+    def sneaky_slot(self, k, v):
+        self.table[k] = v  # line 38: CONC003 (dict subscript write)
+
+    def sneaky_deep(self, k):
+        self.table[k]["n"] += 1  # line 41: CONC003 (nested subscript)
+
+    def sneaky_ann(self, x):
+        self.count: int = x  # line 44: CONC003 (annotated assign)
+
+    def sneaky_del(self, k):
+        del self.table[k]  # line 47: CONC003 (del of guarded container)

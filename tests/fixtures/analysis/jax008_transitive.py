@@ -1,0 +1,13 @@
+"""Fixture: PIO-JAX008 — host sync hidden two calls below the seam."""
+
+
+def predict(model, query):
+    return _gather(model, query)
+
+
+def _gather(model, query):
+    return _pull(model.scores(query))
+
+
+def _pull(x):
+    return x.item()  # line 13: JAX008 (predict -> _gather -> _pull)

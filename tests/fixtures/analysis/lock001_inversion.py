@@ -1,0 +1,19 @@
+"""Fixture: PIO-LOCK001 — the same two locks acquired in opposite
+orders on two paths of one module."""
+
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+
+def ab():
+    with LOCK_A:
+        with LOCK_B:  # line 12: LOCK001 (A held while acquiring B ...)
+            pass
+
+
+def ba():
+    with LOCK_B:
+        with LOCK_A:  # ... while ba() holds B acquiring A — inversion
+            pass

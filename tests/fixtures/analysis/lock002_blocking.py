@@ -1,0 +1,23 @@
+"""Fixture: PIO-LOCK002 — blocking calls while holding a lock."""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bad_wait(self, fut):
+        with self._lock:
+            return fut.result()  # line 12: LOCK002 (unbounded wait)
+
+    def ok_bounded(self, fut):
+        with self._lock:
+            return fut.result(timeout=2)  # clean: bounded wait
+
+    def hidden(self, fut):
+        with self._lock:
+            return self._pull(fut)  # line 20: LOCK002 (reaches .result)
+
+    def _pull(self, fut):
+        return fut.result()  # clean here: no lock held in THIS frame

@@ -1,0 +1,19 @@
+"""Fixture: cross-module lock-order inversion, mod_a half — holds
+LOCK_A and calls into mod_b, which acquires LOCK_B; mod_b's other path
+holds LOCK_B and calls back into take_a()."""
+
+import threading
+
+from lockpair import mod_b
+
+LOCK_A = threading.Lock()
+
+
+def hold_a_then_b():
+    with LOCK_A:
+        mod_b.take_b()  # line 14: LOCK001 (A -> B here, B -> A in mod_b)
+
+
+def take_a():
+    with LOCK_A:
+        pass

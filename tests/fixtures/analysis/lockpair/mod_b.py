@@ -1,0 +1,17 @@
+"""Fixture: cross-module lock-order inversion, mod_b half."""
+
+import threading
+
+from lockpair import mod_a
+
+LOCK_B = threading.Lock()
+
+
+def take_b():
+    with LOCK_B:
+        pass
+
+
+def hold_b_then_a():
+    with LOCK_B:
+        mod_a.take_a()  # the reverse ordering (B held, A acquired)

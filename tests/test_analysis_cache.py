@@ -1,0 +1,215 @@
+"""Check-result cache tests: cold/warm equivalence, content-hash keying,
+rule-set-version eviction, corrupt-file tolerance, the subset-run guard,
+and the CLI `--stats` / `--no-cache` surface.
+
+The invariant under test throughout: the cache can never change what
+`pio check` reports — only how fast it arrives.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from predictionio_tpu.analysis import ALL_RULES, analyze_paths
+from predictionio_tpu.analysis.cache import (
+    CheckCache,
+    file_sha,
+    program_digest,
+    ruleset_version,
+)
+from predictionio_tpu.tools.cli import main as cli_main
+
+FIXTURES = Path(__file__).parent / "fixtures" / "analysis"
+
+
+@pytest.fixture(autouse=True)
+def _isolated_pio_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_HOME", str(tmp_path / "pio-home"))
+
+
+def _tree(tmp_path: Path) -> Path:
+    root = tmp_path / "proj"
+    root.mkdir()
+    (root / "clean.py").write_text("def f():\n    return 1\n")
+    (root / "poll.py").write_text(
+        "import time\n"
+        "def w(x):\n"
+        "    while not x.done:\n"
+        "        time.sleep(1)\n"
+    )
+    return root
+
+
+def _key(report):
+    return [
+        (f.rule, f.file, f.line, f.col, str(f.severity), f.message, f.source)
+        for f in report.findings
+    ]
+
+
+class TestCheckCache:
+    def test_cold_then_warm_identical_reports(self, tmp_path):
+        root = _tree(tmp_path)
+        cpath = tmp_path / "cache.json"
+
+        cold_cache = CheckCache(cpath)
+        cold = analyze_paths([root], root=root, cache=cold_cache)
+        assert cold_cache.hits == 0 and cold_cache.misses == 2
+        assert cpath.exists()
+
+        warm_cache = CheckCache(cpath)
+        warm = analyze_paths([root], root=root, cache=warm_cache)
+        assert warm_cache.hits == 2 and warm_cache.misses == 0
+        assert _key(warm) == _key(cold)
+        assert warm.files_scanned == cold.files_scanned == 2
+        assert warm.pragma_suppressed == cold.pragma_suppressed
+
+    def test_warm_run_preserves_pragma_suppressed_count(self, tmp_path):
+        """The fast path must reassemble suppression counts too, or the
+        render tail changes between cold and warm runs."""
+        root = tmp_path / "proj"
+        root.mkdir()
+        (root / "p.py").write_text(
+            (FIXTURES / "pragma_suppress.py").read_text()
+        )
+        cpath = tmp_path / "cache.json"
+        cold = analyze_paths([root], root=root, cache=CheckCache(cpath))
+        assert cold.pragma_suppressed > 0
+        warm = analyze_paths([root], root=root, cache=CheckCache(cpath))
+        assert warm.pragma_suppressed == cold.pragma_suppressed
+        assert _key(warm) == _key(cold)
+
+    def test_whole_program_findings_survive_the_fast_path(self, tmp_path):
+        """PIO-LOCK findings come from the program-level entry: a full hit
+        must replay them without building the call graph."""
+        root = tmp_path / "proj"
+        root.mkdir()
+        (root / "inv.py").write_text(
+            (FIXTURES / "lock001_inversion.py").read_text()
+        )
+        cpath = tmp_path / "cache.json"
+        cold = analyze_paths([root], root=root, cache=CheckCache(cpath))
+        assert [f.rule for f in cold.findings] == ["PIO-LOCK001"]
+        warm_cache = CheckCache(cpath)
+        warm = analyze_paths([root], root=root, cache=warm_cache)
+        assert warm_cache.hits == 1 and warm_cache.misses == 0
+        assert _key(warm) == _key(cold)
+
+    def test_edited_file_misses_only_itself(self, tmp_path):
+        root = _tree(tmp_path)
+        cpath = tmp_path / "cache.json"
+        analyze_paths([root], root=root, cache=CheckCache(cpath))
+
+        (root / "clean.py").write_text("def f():\n    return 2\n")
+        cache = CheckCache(cpath)
+        report = analyze_paths([root], root=root, cache=cache)
+        assert cache.hits == 1 and cache.misses == 1
+        assert [f.rule for f in report.findings] == ["PIO-CONC002"]
+
+        # and the edit is now cached: the next run is a full hit
+        cache2 = CheckCache(cpath)
+        analyze_paths([root], root=root, cache=cache2)
+        assert cache2.hits == 2 and cache2.misses == 0
+
+    def test_edited_file_changes_program_digest(self, tmp_path):
+        root = _tree(tmp_path)
+        entries = [
+            (p.name, file_sha(p.read_bytes())) for p in root.glob("*.py")
+        ]
+        d1 = program_digest(entries)
+        assert d1 == program_digest(list(reversed(entries)))  # order-free
+        (root / "clean.py").write_text("def f():\n    return 2\n")
+        entries2 = [
+            (p.name, file_sha(p.read_bytes())) for p in root.glob("*.py")
+        ]
+        assert program_digest(entries2) != d1
+
+    def test_subset_rule_runs_bypass_the_cache(self, tmp_path):
+        """A --rules-style subset run must neither read nor poison entries
+        computed under the full rule set."""
+        root = _tree(tmp_path)
+        cpath = tmp_path / "cache.json"
+        analyze_paths([root], root=root, cache=CheckCache(cpath))
+        before = cpath.read_bytes()
+
+        cache = CheckCache(cpath)
+        subset = [ALL_RULES["PIO-CONC002"]]
+        report = analyze_paths([root], root=root, rules=subset, cache=cache)
+        assert cache.hits == 0 and cache.misses == 0
+        assert [f.rule for f in report.findings] == ["PIO-CONC002"]
+        assert cpath.read_bytes() == before
+
+    def test_corrupt_cache_degrades_to_cold(self, tmp_path):
+        root = _tree(tmp_path)
+        cpath = tmp_path / "cache.json"
+        cpath.write_text("{definitely not json")
+        cache = CheckCache(cpath)
+        report = analyze_paths([root], root=root, cache=cache)
+        assert cache.hits == 0 and cache.misses == 2
+        assert [f.rule for f in report.findings] == ["PIO-CONC002"]
+        # and the rewrite healed the file
+        assert json.loads(cpath.read_text())["version"] == 1
+
+    def test_ruleset_version_change_evicts_everything(self, tmp_path):
+        root = _tree(tmp_path)
+        cpath = tmp_path / "cache.json"
+        analyze_paths([root], root=root, cache=CheckCache(cpath))
+
+        doc = json.loads(cpath.read_text())
+        assert doc["ruleset"] == ruleset_version()
+        doc["ruleset"] = "0" * 16  # as if analysis/*.py changed
+        cpath.write_text(json.dumps(doc))
+
+        cache = CheckCache(cpath)
+        report = analyze_paths([root], root=root, cache=cache)
+        assert cache.hits == 0 and cache.misses == 2
+        assert [f.rule for f in report.findings] == ["PIO-CONC002"]
+
+    def test_save_is_atomic_no_tmp_left_behind(self, tmp_path):
+        root = _tree(tmp_path)
+        cpath = tmp_path / "cache.json"
+        analyze_paths([root], root=root, cache=CheckCache(cpath))
+        stray = [p.name for p in tmp_path.iterdir() if "check-cache-" in p.name]
+        assert stray == []
+
+
+class TestCacheCLI:
+    def test_stats_flag_reports_misses_then_hits(self, capsys, monkeypatch):
+        monkeypatch.chdir(FIXTURES)
+        assert cli_main(["check", "conc002_poll.py", "--stats"]) == 1
+        assert "1 miss(es)" in capsys.readouterr().err
+        assert cli_main(["check", "conc002_poll.py", "--stats"]) == 1
+        err = capsys.readouterr().err
+        assert "1 hit(s)" in err and "0 miss(es)" in err
+
+    def test_no_cache_disables_lookup_and_stats(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        monkeypatch.chdir(FIXTURES)
+        assert (
+            cli_main(["check", "conc002_poll.py", "--no-cache", "--stats"])
+            == 1
+        )
+        assert "cache: disabled" in capsys.readouterr().err
+        home = Path(tmp_path / "pio-home")
+        assert not (home / "check-cache.json").exists()
+
+    def test_cache_lands_under_pio_home(self, capsys, monkeypatch, tmp_path):
+        monkeypatch.chdir(FIXTURES)
+        assert cli_main(["check", "conc002_poll.py"]) == 1
+        capsys.readouterr()
+        assert (tmp_path / "pio-home" / "check-cache.json").exists()
+
+    def test_warm_cache_never_changes_the_exit_code(
+        self, capsys, monkeypatch
+    ):
+        monkeypatch.chdir(FIXTURES)
+        out = []
+        for _ in range(2):
+            rc = cli_main(["check", "lock002_blocking.py"])
+            out.append((rc, capsys.readouterr().out))
+        assert out[0][0] == out[1][0] == 1
+        assert out[0][1] == out[1][1]  # identical text render warm vs cold

@@ -164,6 +164,175 @@ class TestContendedLock:
         lock.release()
 
 
+class TestLockWitness:
+    """Runtime lock-order witness (PIO_LOCK_WITNESS=1): executed edge set,
+    inversion detection, and the static-subgraph contract."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh_witness(self):
+        from predictionio_tpu.obs import contention
+
+        w = contention.enable_witness()
+        yield w
+        contention.disable_witness()
+
+    def _run(self, fn) -> None:
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+
+    def test_two_thread_inversion_detected(self, _fresh_witness):
+        """Frozen schedule: thread 1 runs alpha->beta to completion, THEN
+        thread 2 runs beta->alpha — no real contention, but both orders
+        executed, which is exactly the deadlock precondition."""
+        from predictionio_tpu.obs.contention import witness_snapshot
+
+        a = ContendedLock("alpha", registry=MetricsRegistry())
+        b = ContendedLock("beta", registry=MetricsRegistry())
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                with a:
+                    pass
+
+        self._run(t1)
+        snap = witness_snapshot()
+        assert snap["enabled"] and snap["violations"] == []
+        self._run(t2)
+
+        assert _fresh_witness.edge_set() == {
+            ("alpha", "beta"),
+            ("beta", "alpha"),
+        }
+        snap = witness_snapshot()
+        (v,) = snap["violations"]
+        assert v["pair"] == "alpha|beta"
+        assert v["held"] == "beta" and v["acquired"] == "alpha"
+        assert v["stack"] == ["beta", "alpha"]
+
+    def test_violation_lands_in_the_counter(self, _fresh_witness):
+        from predictionio_tpu.obs.metrics import REGISTRY
+
+        a = ContendedLock("w-alpha", registry=MetricsRegistry())
+        b = ContendedLock("w-beta", registry=MetricsRegistry())
+        counter = REGISTRY.counter(
+            "pio_lock_order_violations_total",
+            "Runtime lock-order inversions observed by the LockWitness",
+            labelnames=("pair",),
+        ).labels("w-alpha|w-beta")
+        before = counter.value
+
+        self._run(lambda: [a.acquire(), b.acquire(), b.release(), a.release()])
+        self._run(lambda: [b.acquire(), a.acquire(), a.release(), b.release()])
+        assert counter.value == before + 1
+
+    def test_same_order_twice_is_no_violation(self, _fresh_witness):
+        a = ContendedLock("o-alpha", registry=MetricsRegistry())
+        b = ContendedLock("o-beta", registry=MetricsRegistry())
+        for _ in range(2):
+            self._run(lambda: [a.acquire(), b.acquire(), b.release(), a.release()])
+        assert _fresh_witness.edge_set() == {("o-alpha", "o-beta")}
+        assert _fresh_witness.snapshot()["violations"] == []
+
+    def test_condition_wait_reacquisition_is_witnessed(self, _fresh_witness):
+        """The re-acquisition inside Condition.wait routes through the
+        ContendedLock, so nesting discovered there is recorded too."""
+        outer = ContendedLock("cv-outer", registry=MetricsRegistry())
+        cond = ContendedCondition("cv-inner", registry=MetricsRegistry())
+
+        def waiter():
+            with outer:
+                with cond:
+                    cond.wait(timeout=0.5)
+
+        def notifier():
+            time.sleep(0.05)
+            with cond:
+                cond.notify_all()
+
+        t1 = threading.Thread(target=waiter)
+        t2 = threading.Thread(target=notifier)
+        t1.start(), t2.start()
+        t1.join(), t2.join()
+        assert ("cv-outer", "cv-inner") in _fresh_witness.edge_set()
+        assert _fresh_witness.snapshot()["violations"] == []
+
+    def test_runtime_edges_are_subgraph_of_static_graph(self, _fresh_witness):
+        """The tier-1 contract: every edge the witness observes must exist
+        in the static acquisition graph of the same source — run on a
+        synthetic module where both sides are known exactly."""
+        from predictionio_tpu.analysis.callgraph import build_program
+        from predictionio_tpu.analysis.rules import parse_module
+
+        src = (
+            "from predictionio_tpu.obs.contention import ContendedLock\n"
+            "A = ContendedLock('sg-alpha')\n"
+            "B = ContendedLock('sg-beta')\n"
+            "def ab():\n"
+            "    with A:\n"
+            "        with B:\n"
+            "            pass\n"
+        )
+        program = build_program(
+            [parse_module(None, "sg_mod.py", src)]
+        )
+        allow = program.witness_edge_allowlist()
+        assert allow == {("sg-alpha", "sg-beta")}
+
+        # now EXECUTE the same nesting and compare
+        a = ContendedLock("sg-alpha", registry=MetricsRegistry())
+        b = ContendedLock("sg-beta", registry=MetricsRegistry())
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        self._run(ab)
+        assert _fresh_witness.edge_set() <= allow
+        assert _fresh_witness.snapshot()["violations"] == []
+
+    def test_reentrant_reacquisition_adds_no_edge(self, _fresh_witness):
+        lock = ContendedLock("re-w", registry=MetricsRegistry(), reentrant=True)
+
+        def nest():
+            with lock:
+                with lock:
+                    pass
+
+        self._run(nest)
+        assert _fresh_witness.edge_set() == set()
+
+    def test_snapshot_disabled_shape(self):
+        from predictionio_tpu.obs import contention
+
+        contention.disable_witness()
+        snap = contention.witness_snapshot()
+        assert snap == {"enabled": False, "edges": [], "violations": []}
+
+    def test_per_acquisition_overhead_stays_negligible(self, _fresh_witness):
+        """Budget decomposition instead of a flaky serving A/B: a request
+        on the serving path takes O(10) instrumented acquisitions and p50
+        is ~10ms+, so 5% is >=50us/acquisition.  Assert the witnessed
+        uncontended acquire/release pair stays well under that budget
+        (median of repeated batches, absolute bound)."""
+        lock = ContendedLock("bench", registry=MetricsRegistry())
+        batches = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            for _ in range(1000):
+                with lock:
+                    pass
+            batches.append((time.perf_counter() - t0) / 1000)
+        per_acq = sorted(batches)[len(batches) // 2]
+        assert per_acq < 50e-6, f"witnessed acquire cost {per_acq*1e6:.1f}us"
+
+
 # -- stack sampler -----------------------------------------------------------
 
 
@@ -588,6 +757,48 @@ class TestHTTPSurfaces:
     def test_hotpath_json_absent_without_tracker(self):
         app = _bare_obs_app()
         r = app.handle(Request("GET", "/hotpath.json", {}, {}))
+        assert r.status == 404
+
+    def test_locks_json_serves_witness_snapshot(self):
+        from predictionio_tpu.obs import contention
+
+        w = contention.enable_witness()
+        try:
+            a = ContendedLock("rt-a", registry=MetricsRegistry())
+            b = ContendedLock("rt-b", registry=MetricsRegistry())
+            with a:
+                with b:
+                    pass
+            app = _bare_obs_app()
+            r = app.handle(Request("GET", "/locks.json", {}, {}))
+            assert r.status == 200
+            body = json.loads(r.encoded()[0])
+            assert body["enabled"] is True
+            assert {"src": "rt-a", "dst": "rt-b", "count": 1} in body["edges"]
+            assert body["violations"] == []
+        finally:
+            contention.disable_witness()
+
+    def test_locks_json_reports_disabled_witness(self):
+        from predictionio_tpu.obs import contention
+
+        contention.disable_witness()
+        app = _bare_obs_app()
+        r = app.handle(Request("GET", "/locks.json", {}, {}))
+        assert r.status == 200
+        assert json.loads(r.encoded()[0]) == {
+            "enabled": False, "edges": [], "violations": [],
+        }
+
+    def test_locks_json_gated_with_debug_routes_off(self):
+        from predictionio_tpu.obs.http import add_observability_routes
+        from predictionio_tpu.server.httpd import HTTPApp
+
+        app = HTTPApp("srv")
+        add_observability_routes(
+            app, MetricsRegistry(), debug_routes=False
+        )
+        r = app.handle(Request("GET", "/locks.json", {}, {}))
         assert r.status == 404
 
     def test_capacity_json_shape(self):
