@@ -723,47 +723,10 @@ def bench_event_store(
         shutil.rmtree(root, ignore_errors=True)
 
 
-_CLIENT_SCRIPT = r"""
-# Minimal asyncio load client: N keep-alive connections, pre-encoded request
-# bytes, hand-rolled response framing.  Load generation shares this box's
-# CPU with the server under test (single-core machine image), so every
-# microsecond of client overhead inflates the server's measured latency.
-# Runs ``rounds`` independent rounds, one JSON result line each — spawned
-# ONCE (before the parent deprioritizes itself) so it never inherits a
-# degraded priority.
-import asyncio, json, sys, time
-port, conns, per_conn, num_users, rounds = (int(a) for a in sys.argv[1:6])
-
-def req_bytes(uid):
-    body = b'{"user": "%d", "num": 10}' % uid
-    return (b"POST /queries.json HTTP/1.1\r\nHost: x\r\n"
-            b"Content-Type: application/json\r\n"
-            b"Content-Length: %d\r\n\r\n%s" % (len(body), body))
-
-async def client(cid, lats):
-    reader, writer = await asyncio.open_connection("127.0.0.1", port)
-    for q in range(per_conn):
-        payload = req_bytes((cid * per_conn + q) % num_users)
-        t0 = time.perf_counter()
-        writer.write(payload)
-        head = await reader.readuntil(b"\r\n\r\n")
-        clen = int(head.lower().split(b"content-length:")[1].split(b"\r\n")[0])
-        body = await reader.readexactly(clen)
-        lats.append(time.perf_counter() - t0)
-        assert head.startswith(b"HTTP/1.1 200"), head[:80] + body[:200]
-    writer.close()
-
-async def one_round():
-    lats = []
-    await asyncio.gather(*(client(c, lats) for c in range(conns)))
-    return lats
-
-for _ in range(rounds):
-    lats = sorted(asyncio.run(one_round()))
-    print(json.dumps({"p50_ms": lats[len(lats) // 2] * 1000,
-                      "p99_ms": lats[int(len(lats) * 0.99)] * 1000}),
-          flush=True)
-"""
+# The asyncio load client lives in predictionio_tpu.replay.workload (one
+# traffic generator for BENCH and the production-day harness); it's spawned
+# as `python -m predictionio_tpu.replay.workload PORT CONNS PER_CONN
+# NUM_USERS ROUNDS` and prints one JSON result line per round.
 
 
 _SERVER_SCRIPT = r"""
@@ -845,13 +808,13 @@ def bench_fleet_section(model, num_users, n_replicas: int, requests: int = 300):
     the router's whole value is affinity + failover at near-zero latency
     cost, and ``fleet_router_overhead_ms`` is the regression gate on that
     claim (BENCH_GATE_METRICS)."""
-    import http.client
     import subprocess
     import tempfile
 
     from predictionio_tpu.fleet.membership import FleetState
     from predictionio_tpu.fleet.router import create_router_app
     from predictionio_tpu.obs.metrics import MetricsRegistry
+    from predictionio_tpu.replay.workload import measure_closed_loop
     from predictionio_tpu.server.httpd import AppServer
 
     with tempfile.NamedTemporaryFile(suffix=".npz", delete=False) as f:
@@ -893,21 +856,10 @@ def bench_fleet_section(model, num_users, n_replicas: int, requests: int = 300):
         ).start_background()
 
         def measure(port: int, n: int) -> list[float]:
-            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
-            lats = []
-            for q in range(n):
-                body = b'{"user": "%d", "num": 10}' % (q % num_users)
-                t0 = time.perf_counter()
-                conn.request(
-                    "POST", "/queries.json", body=body,
-                    headers={"Content-Type": "application/json"},
-                )
-                resp = conn.getresponse()
-                data = resp.read()
-                lats.append((time.perf_counter() - t0) * 1000)
-                assert resp.status == 200, (resp.status, data[:200])
-            conn.close()
-            return sorted(lats)
+            # shared closed-loop client (predictionio_tpu.replay.workload) —
+            # same keep-alive loop BENCH always used, now also the unit the
+            # `pio day` harness builds on
+            return measure_closed_loop("127.0.0.1", port, n, num_users)
 
         measure(ports[0], 20)  # warm the direct path (jit + keep-alive)
         measure(router.port, 20)  # warm the router path + all replicas
@@ -960,6 +912,140 @@ def bench_fleet_section(model, num_users, n_replicas: int, requests: int = 300):
             pass
 
 
+#: the scripted day `bench.py --fleet N --day` replays: fixed script +
+#: fixed seed so fleet_day_* numbers are comparable release over release
+#: (the gate refuses to compare runs whose scenario echo differs)
+_DAY_SCENARIO = {
+    "name": "bench-mini-day",
+    "seed": 7,
+    "num_entities": 12,
+    "num_items": 10,
+    "max_inflight": 32,
+    "phases": [
+        {"name": "warm", "duration_s": 6, "qps": 8, "read_frac": 1.0,
+         "p99_ms": 5000},
+        {"name": "peak", "duration_s": 12, "qps": 20, "read_frac": 0.85,
+         "p99_ms": 5000},
+        {"name": "cool", "duration_s": 6, "qps": 8, "read_frac": 1.0,
+         "p99_ms": 5000},
+    ],
+    "actions": [
+        {"at_s": 9, "kind": "kill_replica"},
+        {"at_s": 14, "kind": "canary_flip"},
+    ],
+    "slo": {"autoscaler_tolerance": 2},
+}
+
+
+def bench_fleet_day_section(n_replicas: int):
+    """`python bench.py --fleet N --day`: the production-day section.
+
+    Replays the fixed ``_DAY_SCENARIO`` through the real multi-replica
+    topology (``pio day``) in a throwaway PIO_HOME — subprocess-isolated
+    like the sharded section, cpu-pinned so the replicas never fight this
+    process for the device — and distills the report into the schema-v8
+    ``fleet_day_*`` gate metrics plus the verdict booleans as
+    diagnostics."""
+    import hashlib
+    import shutil
+    import subprocess
+    import tempfile
+
+    day_home = tempfile.mkdtemp(prefix="pio-bench-day-")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, PIO_HOME=day_home, JAX_PLATFORMS="cpu")
+    scenario_path = os.path.join(day_home, "scenario.json")
+    report_path = os.path.join(day_home, "report.json")
+    with open(scenario_path, "w") as f:
+        json.dump(_DAY_SCENARIO, f)
+    try:
+        seeded = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import sys; from predictionio_tpu.replay.day import "
+                "seed_demo_home; seed_demo_home(sys.argv[1])",
+                day_home,
+            ],
+            env=env, cwd=repo, capture_output=True, text=True, timeout=600,
+        )
+        if seeded.returncode != 0:
+            raise RuntimeError(
+                f"day seeding failed: {seeded.stderr[-800:]}"
+            )
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "predictionio_tpu.tools.cli", "day",
+                "--scenario", f"@{scenario_path}",
+                "--replicas", str(n_replicas),
+                "--seed", str(_DAY_SCENARIO["seed"]),
+                "--report", report_path,
+            ],
+            env=env, cwd=repo, capture_output=True, text=True, timeout=900,
+        )
+        if not os.path.exists(report_path):
+            raise RuntimeError(
+                f"pio day produced no report (exit {proc.returncode}): "
+                f"{proc.stderr[-800:] or proc.stdout[-800:]}"
+            )
+        with open(report_path) as f:
+            report = json.load(f)
+        verdict = report["verdict"]
+        rows = verdict.get("phases", [])
+        p99s = [
+            r.get("telemetry_p99_ms") or r.get("p99_ms")
+            for r in rows
+            if (r.get("telemetry_p99_ms") or r.get("p99_ms")) is not None
+        ]
+        scheduled = sum(int(r.get("scheduled", 0)) for r in rows)
+        answered = sum(int(r.get("answered", 0)) for r in rows)
+        shed = sum(float(r.get("shed", 0.0) or 0.0) for r in rows)
+        retry = sum(
+            float(r.get("retry_elsewhere_rate", 0.0) or 0.0)
+            * int(r.get("answered", 0))
+            for r in rows
+        )
+        device_s = sum(
+            float(r.get("device_s", 0.0) or 0.0)
+            for r in rows
+            if r.get("device_s") is not None
+        )
+        # config echo: name + content hash; two runs only compare when the
+        # scripted day was byte-identical
+        digest = hashlib.sha256(
+            json.dumps(_DAY_SCENARIO, sort_keys=True).encode()
+        ).hexdigest()[:12]
+        out = {
+            "fleet_day_scenario": f"{_DAY_SCENARIO['name']}@{digest}",
+            "fleet_day_p99_ms": round(max(p99s), 3) if p99s else None,
+            "fleet_day_shed_rate": round(shed / scheduled, 6)
+            if scheduled else 0.0,
+            "fleet_day_retry_rate": round(retry / answered, 6)
+            if answered else 0.0,
+            "fleet_day_device_s": round(device_s, 6),
+            "fleet_day_verdict_pass": bool(verdict.get("pass")),
+            "fleet_day": {
+                "exit_code": proc.returncode,
+                "clauses": {
+                    c["clause"]: bool(c["passed"])
+                    for c in verdict.get("clauses", [])
+                },
+                "requests": verdict.get("requests"),
+            },
+        }
+        log(
+            f"# fleet_day scenario={out['fleet_day_scenario']} "
+            f"verdict={'PASS' if out['fleet_day_verdict_pass'] else 'FAIL'} "
+            f"p99={out['fleet_day_p99_ms']}ms "
+            f"shed_rate={out['fleet_day_shed_rate']:.4f} "
+            f"retry_rate={out['fleet_day_retry_rate']:.4f} "
+            f"device_s={out['fleet_day_device_s']:.3f}"
+        )
+        return out
+    finally:
+        shutil.rmtree(day_home, ignore_errors=True)
+
+
 def serving_p50_concurrent(model, num_users, clients=32, per_client=40):
     """p50/p99 across 32 concurrent keep-alive clients hitting a real
     asyncio server + micro-batched /queries.json route.  Server AND load
@@ -1007,8 +1093,8 @@ def serving_p50_concurrent(model, num_users, clients=32, per_client=40):
         client = subprocess.Popen(
             [
                 sys.executable,
-                "-c",
-                _CLIENT_SCRIPT,
+                "-m",
+                "predictionio_tpu.replay.workload",
                 str(port),
                 str(clients),
                 str(per_client),
@@ -1018,6 +1104,7 @@ def serving_p50_concurrent(model, num_users, clients=32, per_client=40):
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
         )
         # deprioritize THIS process while the rounds run: accelerator-tunnel
         # background threads keep burning cycles even though the parent just
@@ -1925,11 +2012,18 @@ def main() -> None:
     fleet_replicas = 0
     if "--fleet" in sys.argv:
         fleet_replicas = int(sys.argv[sys.argv.index("--fleet") + 1])
+    # --day: the scripted production-day section (pio day over real
+    # replica subprocesses; seeds its own PIO_HOME, so it runs even
+    # without a trained state in this process)
+    run_day = "--day" in sys.argv
 
     def sec_fleet():
         metrics.update(
             bench_fleet_section(C.state, num_users, fleet_replicas)
         )
+
+    def sec_fleet_day():
+        metrics.update(bench_fleet_day_section(max(fleet_replicas, 2)))
 
     def sec_sharded():
         res = bench_sharded_section(
@@ -1982,6 +2076,8 @@ def main() -> None:
         else:
             failed.append("fleet")
             log("# SECTION fleet SKIPPED: no trained ALS state")
+    if run_day:
+        run_section("fleet_day", sec_fleet_day)
 
     from predictionio_tpu.obs.device import BENCH_SCHEMA_VERSION
 

@@ -184,6 +184,19 @@ class LocalProcessSpawner(ReplicaSpawner):
             self._pacer.wait(self.poll_interval_s)
         raise TimeoutError(f"replica {url} never answered /readyz")
 
+    def pid_of(self, url: str) -> int | None:
+        """The live pid behind a spawned replica url (None when unknown or
+        dead) — chaos harnesses SIGKILL through this instead of groping
+        pidfiles."""
+        from predictionio_tpu.tools import daemon
+
+        with self._lock:
+            pidfile = self._pidfiles.get(url)
+        if pidfile is None:
+            return None
+        pid = daemon.read_pidfile(pidfile)
+        return pid if daemon.pid_alive(pid) else None
+
     def wait_replica_drained(self, url: str, timeout_s: float | None = None) -> bool:
         """Poll the replica's /status.json generation-refcount surface
         until idle; True when it drained inside the timeout."""

@@ -844,9 +844,18 @@ def als_plan_roofline(plan: Mapping[str, Any]) -> dict[str, float] | None:
 #: ledger billing vs without), and the attribution coverage fraction
 #: (``cost_attribution_coverage_frac`` — attributed device-seconds over
 #: measured device-seconds, 1.0 when conservation holds), plus the
-#: event-visibility freshness p99 echo (``events_visibility_lag_p99_s``).
-#: ``pio bench --compare`` refuses version-less or older files.
-BENCH_SCHEMA_VERSION = 7
+#: event-visibility freshness p99 echo (``events_visibility_lag_p99_s``);
+#: v8 adds the ``fleet_day`` section (``bench.py --fleet N --day``): a
+#: scripted mini production day replayed through the real multi-replica
+#: topology — worst-phase tail latency (``fleet_day_p99_ms``), shed and
+#: retry-elsewhere rates over the whole day (``fleet_day_shed_rate`` /
+#: ``fleet_day_retry_rate``), total attributed device cost
+#: (``fleet_day_device_s``), the verdict booleans as diagnostics, and the
+#: ``fleet_day_scenario`` config echo the gate refuses to cross-compare
+#: (a calm day vs one with a mid-peak SIGKILL is not the same
+#: measurement).  ``pio bench --compare`` refuses version-less or older
+#: files.
+BENCH_SCHEMA_VERSION = 8
 
 #: regression-gateable BENCH metrics and which direction is better.  Only
 #: keys present in BOTH files are compared; everything else (configuration
@@ -901,6 +910,13 @@ BENCH_GATE_METRICS: dict[str, str] = {
     "cost_metering_overhead_pct": "lower",
     "cost_attribution_coverage_frac": "higher",
     "events_visibility_lag_p99_s": "lower",
+    # production-day section (schema v8, bench --fleet N --day): the whole
+    # scripted day must not get slower, sheddier, retry-happier or more
+    # expensive release over release
+    "fleet_day_p99_ms": "lower",
+    "fleet_day_shed_rate": "lower",
+    "fleet_day_retry_rate": "lower",
+    "fleet_day_device_s": "lower",
 }
 
 
@@ -963,6 +979,18 @@ def compare_bench(
             f"fleet sections differ: current fleet_replicas={cur_fleet!r} "
             f"vs previous {prev_fleet!r} — re-run bench with the same "
             "--fleet to compare"
+        )
+        return 2, report
+    # production-day section config: fleet_day_* numbers only compare when
+    # the scripted day was the same script — a calm day vs one with a
+    # mid-peak SIGKILL "regresses" by construction
+    cur_day = current.get("fleet_day_scenario")
+    prev_day = previous.get("fleet_day_scenario")
+    if cur_day != prev_day:
+        report["error"] = (
+            f"production-day sections differ: current fleet_day_scenario="
+            f"{cur_day!r} vs previous {prev_day!r} — re-run bench with the "
+            "same --day scenario to compare"
         )
         return 2, report
     # event-store section config: a 100M-row write rate vs a 20M one is

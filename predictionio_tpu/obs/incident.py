@@ -48,6 +48,21 @@ DEFAULT_MAX_AGE_S = 7 * 86400.0
 DEFAULT_MIN_INTERVAL_S = 60.0
 
 
+def min_interval_from_env(default: float = DEFAULT_MIN_INTERVAL_S) -> float:
+    """``PIO_INCIDENT_MIN_INTERVAL_S`` — per-rule bundle cooldown in
+    seconds (default 60).  A rule flapping at evaluator frequency writes at
+    most one bundle per cooldown window; the rest only increment
+    ``pio_incidents_suppressed_total{rule}``.  Malformed values fall back
+    to the default rather than killing server startup."""
+    raw = os.environ.get("PIO_INCIDENT_MIN_INTERVAL_S")
+    if not raw:
+        return default
+    try:
+        return max(float(raw), 0.0)
+    except ValueError:
+        return default
+
+
 def default_incident_dir() -> str:
     """``PIO_INCIDENT_DIR`` or ``$PIO_HOME/incidents`` — shared by the
     serving process (writer) and a co-located dashboard (reader)."""
@@ -76,7 +91,7 @@ class IncidentRecorder:
         app: Any = None,
         max_count: int = DEFAULT_MAX_COUNT,
         max_age_s: float = DEFAULT_MAX_AGE_S,
-        min_interval_s: float = DEFAULT_MIN_INTERVAL_S,
+        min_interval_s: float | None = None,
         clock: Callable[[], float] = time.monotonic,
         fragments: Any = None,
         max_traces: int = 16,
@@ -90,7 +105,11 @@ class IncidentRecorder:
         self.app = app
         self.max_count = max(int(max_count), 1)
         self.max_age_s = float(max_age_s)
-        self.min_interval_s = float(min_interval_s)
+        self.min_interval_s = (
+            min_interval_from_env()
+            if min_interval_s is None
+            else float(min_interval_s)
+        )
         self.max_traces = max_traces
         self.stack_burst_s = float(stack_burst_s)
         self._clock = clock

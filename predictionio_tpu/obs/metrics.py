@@ -481,6 +481,26 @@ class MetricsRegistry:
                 out[fam.name]["bounds"] = list(fam.buckets)
         return out
 
+    def delta_snapshot(
+        self, prev: Mapping[str, Any] | None = None
+    ) -> dict[str, Any]:
+        """``render_json()`` minus a previous snapshot of the same registry.
+
+        Counters subtract values; histograms subtract per-bucket counts and
+        sums, then recompute p50/p95/p99 from the *delta* buckets — so a
+        phase window gets true in-window quantiles without registering a
+        second histogram family.  Gauges are point-in-time and pass through
+        unchanged.  ``prev=None`` returns a plain absolute snapshot (the
+        baseline for the next call).  Series absent from ``prev`` (born
+        mid-window) subtract zero; series absent from the current snapshot
+        are dropped.  See :func:`subtract_snapshots` for the pure-data form
+        used on scraped ``/metrics.json`` payloads.
+        """
+        current = self.render_json()
+        if prev is None:
+            return current
+        return subtract_snapshots(current, prev)
+
     def histogram_quantiles(
         self, name: str, qs: Iterable[float] = (0.50, 0.95, 0.99)
     ) -> dict[str, Any]:
@@ -498,6 +518,86 @@ class MetricsRegistry:
                     fam.buckets, counts, count, q
                 )
         return out
+
+
+def subtract_snapshots(
+    current: Mapping[str, Any], previous: Mapping[str, Any]
+) -> dict[str, Any]:
+    """Elementwise difference of two ``render_json()``-shaped snapshots.
+
+    The window algebra behind per-phase verdicts: scrape once at each phase
+    boundary, subtract, and the result *is* a valid snapshot of just that
+    window (cumulative buckets over fixed bounds subtract cleanly — the
+    reason ``LATENCY_BUCKETS`` are fixed per family).  Counter values,
+    histogram bucket counts, sums, and counts subtract, clamped at zero so a
+    restarted process (counter reset) degrades to "window starts at
+    restart" instead of going negative; histogram quantiles are recomputed
+    from the delta buckets.  Gauges keep their current value.
+    """
+    out: dict[str, Any] = {}
+    for name, fam in current.items():
+        if not isinstance(fam, Mapping) or "series" not in fam:
+            continue
+        prev_fam = previous.get(name)
+        prev_series: dict[str, Mapping[str, Any]] = {}
+        if isinstance(prev_fam, Mapping) and prev_fam.get("type") == fam.get(
+            "type"
+        ):
+            for s in prev_fam.get("series", ()):
+                prev_series[json.dumps(s.get("labels", {}), sort_keys=True)] = s
+        kind = fam.get("type")
+        bounds = list(fam.get("bounds", []))
+        series_out = []
+        for s in fam.get("series", ()):
+            p = prev_series.get(
+                json.dumps(s.get("labels", {}), sort_keys=True), {}
+            )
+            if kind == "counter":
+                series_out.append(
+                    {
+                        "labels": dict(s.get("labels", {})),
+                        "value": max(
+                            float(s.get("value", 0.0))
+                            - float(p.get("value", 0.0)),
+                            0.0,
+                        ),
+                    }
+                )
+            elif kind == "histogram":
+                cur_b = list(s.get("buckets", []))
+                prev_b = list(p.get("buckets", []))
+                prev_b += [0] * (len(cur_b) - len(prev_b))
+                buckets = [max(c - q, 0) for c, q in zip(cur_b, prev_b)]
+                count = max(int(s.get("count", 0)) - int(p.get("count", 0)), 0)
+                entry: dict[str, Any] = {
+                    "labels": dict(s.get("labels", {})),
+                    "count": count,
+                    "sum": max(
+                        float(s.get("sum", 0.0)) - float(p.get("sum", 0.0)),
+                        0.0,
+                    ),
+                    "buckets": buckets,
+                }
+                for q in (0.50, 0.95, 0.99):
+                    entry[f"p{int(q * 100)}"] = quantile_from_buckets(
+                        bounds, buckets, count, q
+                    )
+                series_out.append(entry)
+            else:  # gauge: point-in-time, no delta semantics
+                series_out.append(
+                    {
+                        "labels": dict(s.get("labels", {})),
+                        "value": s.get("value", 0.0),
+                    }
+                )
+        out[name] = {
+            "type": kind,
+            "help": fam.get("help", ""),
+            "series": series_out,
+        }
+        if kind == "histogram":
+            out[name]["bounds"] = bounds
+    return out
 
 
 #: Process-global default registry — what servers, the MicroBatcher, and the

@@ -46,6 +46,7 @@ from predictionio_tpu.obs.http import add_observability_routes
 from predictionio_tpu.obs.logging import get_request_id
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
 from predictionio_tpu.obs.quality import QualityMonitor, default_quality
+from predictionio_tpu.resilience import faults
 from predictionio_tpu.server.httpd import (
     AppServer,
     HTTPApp,
@@ -244,6 +245,14 @@ def create_event_server_app(
         labelnames=("event",),
     )
 
+    def _store_seam(app_id: int) -> None:
+        """The ``eventstore.write`` fault seam, checked with the write's
+        ingest-gate slot held: a latency rule stalls exactly like a slow
+        store (saturation, then 503 shed); raising kinds surface as the
+        store being down (retryable 503)."""
+        if faults.ACTIVE is not None:
+            faults.ACTIVE.check("eventstore.write", str(app_id))
+
     def authed(handler):
         def wrapped(req: Request) -> Response:
             try:
@@ -320,6 +329,7 @@ def create_event_server_app(
         except Exception as e:  # an input blocker rejected the event
             return error_response(403, f"rejected by plugin: {e}")
         try:
+            _store_seam(auth.app_id)
             event_id = levents.insert(event, auth.app_id, auth.channel_id)
         except _STORE_UNAVAILABLE as e:
             return _unavailable_response(e)
@@ -417,6 +427,7 @@ def create_event_server_app(
                 )
                 continue
             try:
+                _store_seam(auth.app_id)
                 event_id = levents.insert(event, auth.app_id, auth.channel_id)
             except _STORE_UNAVAILABLE as e:
                 # per-item 503: the batch contract stays "one status per
@@ -468,6 +479,7 @@ def create_event_server_app(
         except Exception as e:
             return error_response(403, f"rejected by plugin: {e}")
         try:
+            _store_seam(auth.app_id)
             event_id = levents.insert(event, auth.app_id, auth.channel_id)
         except _STORE_UNAVAILABLE as e:
             return _unavailable_response(e)

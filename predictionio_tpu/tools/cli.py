@@ -2201,6 +2201,44 @@ def do_bench(args) -> int:
     return code
 
 
+def do_day(args) -> int:
+    """`pio day --scenario FILE [--replicas N] [--report OUT.json]
+    [--seed S]`: run one scripted production day against the real fleet
+    topology (router + N ``pio deploy`` replica subprocesses + event
+    ingest) and print the evidence-backed SLO verdict.
+
+    Exit contract: 0 = verdict PASS, 1 = verdict FAIL, 2 = malformed
+    scenario (the message names the offending field).  ``PIO_HOME`` must
+    already hold a trained engine (``pio train`` or the test seeders).
+    """
+    from predictionio_tpu.replay.day import run_day
+    from predictionio_tpu.replay.scenario import Scenario, ScenarioError
+
+    try:
+        scenario = Scenario.load_arg(args.scenario)
+    except ScenarioError as e:
+        print(f"malformed scenario: {e}", file=sys.stderr)
+        return 2
+    except OSError as e:
+        print(f"malformed scenario: cannot read file: {e}", file=sys.stderr)
+        return 2
+    try:
+        code, _report = run_day(
+            scenario,
+            replicas=args.replicas,
+            seed=args.seed,
+            engine=args.engine,
+            report_path=args.report,
+            incident_dir=args.incident_dir,
+            disable_incidents=args.no_incidents,
+        )
+    except CommandError:
+        raise
+    except RuntimeError as e:
+        raise CommandError(str(e)) from e
+    return code
+
+
 def do_build(args) -> int:
     """`pio build` parity: engines are plain Python — nothing to compile.
     Validates the engine.json instead (the useful part of the verb)."""
@@ -3050,6 +3088,49 @@ def build_parser() -> argparse.ArgumentParser:
         help="allowed regression per metric in percent (default 10)",
     )
     bn.set_defaults(fn=do_bench)
+
+    dy = sub.add_parser(
+        "day",
+        help="run a scripted production day and print the SLO verdict",
+        description="Drive the real fleet topology (router + N replica "
+        "subprocesses + event ingest) through a declarative scripted day "
+        "of traffic phases and timed faults, then join the generator's "
+        "outcome log, scraped telemetry and the incident-bundle "
+        "directory into an evidence-backed verdict.  Exit 0 PASS / "
+        "1 FAIL / 2 malformed scenario.",
+    )
+    dy.add_argument(
+        "--scenario",
+        required=True,
+        metavar="JSON|@FILE",
+        help="scenario document: inline JSON or @path (docs/production_day.md)",
+    )
+    dy.add_argument(
+        "--replicas", type=int, default=2, help="replica subprocesses (default 2)"
+    )
+    dy.add_argument(
+        "--seed", type=int, default=None,
+        help="override the scenario's schedule seed",
+    )
+    dy.add_argument(
+        "--engine", default="recommendation",
+        help="registered engine factory the replicas deploy (default "
+        "recommendation)",
+    )
+    dy.add_argument(
+        "--report", metavar="OUT.json", default=None,
+        help="write the machine-readable verdict report here",
+    )
+    dy.add_argument(
+        "--incident-dir", default=None,
+        help="incident-bundle directory for the run (default: fresh temp dir)",
+    )
+    dy.add_argument(
+        "--no-incidents", action="store_true",
+        help="disable the incident recorder (falsification runs: the "
+        "verdict must FAIL its fault-reconciliation clause)",
+    )
+    dy.set_defaults(fn=do_day)
 
     bd = sub.add_parser("build")
     bd.add_argument("--engine")

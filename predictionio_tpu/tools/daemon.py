@@ -97,6 +97,16 @@ def pid_alive(pid: int | None) -> bool:
         cmdline = Path(f"/proc/{pid}/cmdline").read_bytes()
     except OSError:
         return True  # no procfs: can't disambiguate, assume it's ours
+    if not cmdline:
+        # /proc cmdline is EMPTY both for a zombie (dead, unreaped —
+        # forever) and for a live process mid-execve (a few ms window).
+        # Conflating them made spawn() declare a booting replica "died at
+        # boot"; the stat state field tells them apart.
+        try:
+            stat = Path(f"/proc/{pid}/stat").read_text()
+            return stat.rsplit(")", 1)[1].split()[0] != "Z"
+        except (OSError, IndexError):
+            return True
     return b"predictionio_tpu" in cmdline
 
 

@@ -1,0 +1,648 @@
+"""Replay harness units: seeded schedules, scenario validation, the
+shared workload loops, phase-window snapshot algebra, incident-bundle
+cooldown, and the verdict engine's clause catalog (docs/production_day.md).
+
+The end-to-end `pio day` run with real replica subprocesses lives in
+test_production_day.py; everything here is fast and in-process.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs.metrics import MetricsRegistry, subtract_snapshots
+from predictionio_tpu.obs.verdict import evaluate_day, render_verdict
+from predictionio_tpu.replay.scenario import Scenario, ScenarioError
+from predictionio_tpu.replay.workload import (
+    OpenLoopRunner,
+    build_phase_schedule,
+    measure_closed_loop,
+    run_load_rounds,
+    schedule_digest,
+    zipf_entities,
+)
+
+MINI = {
+    "name": "t",
+    "phases": [
+        {"name": "a", "duration_s": 2.0, "qps": 10},
+        {"name": "b", "duration_s": 3.0, "qps": 20, "read_frac": 0.5},
+    ],
+    "actions": [{"at_s": 1.0, "kind": "kill_replica"}],
+    "num_entities": 100,
+}
+
+
+# ---------------------------------------------------------------------------
+# schedules: determinism + skew
+# ---------------------------------------------------------------------------
+
+
+class TestSchedules:
+    def test_same_seed_byte_identical(self):
+        s = Scenario.from_dict(MINI)
+        d1 = schedule_digest(s.build_schedules(42))
+        d2 = schedule_digest(s.build_schedules(42))
+        assert d1 == d2
+
+    def test_different_seed_differs(self):
+        s = Scenario.from_dict(MINI)
+        assert schedule_digest(s.build_schedules(1)) != schedule_digest(
+            s.build_schedules(2)
+        )
+
+    def test_phase_rng_isolated(self):
+        """Editing a later phase never perturbs an earlier one (per-phase
+        RNG is derived from (seed, index))."""
+        edited = dict(MINI, phases=[MINI["phases"][0],
+                                    dict(MINI["phases"][1], qps=40)])
+        a = Scenario.from_dict(MINI).build_schedules(7)[0]
+        b = Scenario.from_dict(edited).build_schedules(7)[0]
+        assert np.array_equal(a.at, b.at)
+        assert np.array_equal(a.entity, b.entity)
+
+    def test_schedule_shape(self):
+        s = Scenario.from_dict(MINI).build_schedules(0)
+        assert len(s[0]) == 20 and len(s[1]) == 60
+        # open-loop pacing: sorted offsets inside [start, start+duration)
+        assert np.all(np.diff(s[1].at) >= 0)
+        assert s[1].at[0] >= s[1].start_s
+        assert s[1].at[-1] < s[1].start_s + s[1].duration_s
+        # request ids unique across phases
+        ids = {p.request_id(i, "r") for p in s for i in range(len(p))}
+        assert len(ids) == 80
+
+    def test_zipf_skew_over_millions(self):
+        """O(1)-memory Zipf: millions of entities, hot head, full range
+        validity, deterministic under the same generator state."""
+        rng = np.random.Generator(np.random.PCG64(0))
+        e = zipf_entities(rng, 20000, 5_000_000, exponent=1.1)
+        assert e.min() >= 0 and e.max() < 5_000_000
+        counts = np.bincount(e[e < 10])
+        # rank-1 entity dominates rank-10
+        assert counts[0] > counts[-1] * 2
+        rng2 = np.random.Generator(np.random.PCG64(0))
+        assert np.array_equal(e, zipf_entities(rng2, 20000, 5_000_000, 1.1))
+
+    def test_zipf_offset_rotates_head(self):
+        rng = np.random.Generator(np.random.PCG64(3))
+        e = zipf_entities(rng, 500, 1000, offset=700)
+        vals, counts = np.unique(e, return_counts=True)
+        assert vals[np.argmax(counts)] == 700
+
+
+# ---------------------------------------------------------------------------
+# scenario validation
+# ---------------------------------------------------------------------------
+
+
+class TestScenarioValidation:
+    def test_negative_qps_names_field(self):
+        bad = dict(MINI, phases=[{"name": "a", "duration_s": 1, "qps": -5}])
+        with pytest.raises(ScenarioError) as ei:
+            Scenario.from_dict(bad)
+        assert ei.value.field == "phases[0].qps"
+
+    def test_unknown_action_names_field(self):
+        bad = dict(MINI, actions=[{"at_s": 0, "kind": "meteor_strike"}])
+        with pytest.raises(ScenarioError) as ei:
+            Scenario.from_dict(bad)
+        assert ei.value.field == "actions[0].kind"
+        assert "meteor_strike" in str(ei.value)
+
+    def test_overlapping_phases_name_field(self):
+        bad = dict(
+            MINI,
+            phases=[
+                {"name": "a", "duration_s": 5, "qps": 1},
+                {"name": "b", "duration_s": 5, "qps": 1, "start_s": 2.0},
+            ],
+        )
+        with pytest.raises(ScenarioError) as ei:
+            Scenario.from_dict(bad)
+        assert ei.value.field == "phases[1].start_s"
+
+    def test_empty_phases(self):
+        with pytest.raises(ScenarioError) as ei:
+            Scenario.from_dict({"name": "t", "phases": []})
+        assert ei.value.field == "phases"
+
+    def test_read_frac_out_of_range(self):
+        bad = dict(
+            MINI, phases=[{"name": "a", "duration_s": 1, "qps": 1,
+                           "read_frac": 1.5}]
+        )
+        with pytest.raises(ScenarioError) as ei:
+            Scenario.from_dict(bad)
+        assert ei.value.field == "phases[0].read_frac"
+
+    def test_action_beyond_day_end(self):
+        bad = dict(MINI, actions=[{"at_s": 99.0, "kind": "kill_replica"}])
+        with pytest.raises(ScenarioError) as ei:
+            Scenario.from_dict(bad)
+        assert ei.value.field == "actions[0].at_s"
+
+    def test_load_arg_inline_and_file(self, tmp_path):
+        s = Scenario.load_arg(json.dumps(MINI))
+        assert s.name == "t" and s.total_duration_s == 5.0
+        p = tmp_path / "sc.json"
+        p.write_text(json.dumps(MINI))
+        assert Scenario.load_arg(f"@{p}").name == "t"
+
+    def test_round_trip(self):
+        s = Scenario.from_dict(MINI)
+        assert Scenario.from_dict(s.to_dict()).to_dict() == s.to_dict()
+
+
+class TestDayCliMalformed:
+    """`pio day` exits 2 on malformed scenarios, naming the field —
+    before any topology is touched."""
+
+    def run(self, arg, capsys):
+        from predictionio_tpu.tools.cli import main as cli_main
+
+        code = cli_main(["day", "--scenario", arg])
+        return code, capsys.readouterr().err
+
+    def test_bad_json(self, capsys):
+        code, err = self.run("{nope", capsys)
+        assert code == 2 and "malformed scenario" in err
+
+    def test_negative_qps(self, capsys):
+        bad = dict(MINI, phases=[{"name": "a", "duration_s": 1, "qps": -1}])
+        code, err = self.run(json.dumps(bad), capsys)
+        assert code == 2 and "phases[0].qps" in err
+
+    def test_unknown_action(self, capsys):
+        bad = dict(MINI, actions=[{"at_s": 0, "kind": "volcano"}])
+        code, err = self.run(json.dumps(bad), capsys)
+        assert code == 2 and "actions[0].kind" in err
+
+    def test_missing_file(self, capsys, tmp_path):
+        code, err = self.run(f"@{tmp_path}/absent.json", capsys)
+        assert code == 2 and "malformed scenario" in err
+
+
+# ---------------------------------------------------------------------------
+# delta snapshots: the phase-window algebra the verdict runs on
+# ---------------------------------------------------------------------------
+
+
+class TestDeltaSnapshot:
+    def test_histogram_quantiles_are_in_window(self):
+        """A stream split across two phases: the delta's quantiles see
+        ONLY the second window, the absolute snapshot sees the mixture."""
+        reg = MetricsRegistry()
+        h = reg.histogram("pio_router_forward_seconds", "t", labelnames=("replica",))
+        for _ in range(200):
+            h.labels("r1").observe(0.004)  # phase A: fast
+        snap = reg.render_json()
+        for _ in range(100):
+            h.labels("r1").observe(0.4)  # phase B: 100x slower
+        delta = reg.delta_snapshot(snap)
+        series = delta["pio_router_forward_seconds"]["series"][0]
+        assert series["count"] == 100
+        # in-window p50 lands in phase B territory; the cumulative one
+        # is still dominated by phase A's 200 fast samples
+        assert series["p50"] > 0.1
+        full = reg.render_json()["pio_router_forward_seconds"]["series"][0]
+        assert full["count"] == 300 and full["p50"] < 0.1
+
+    def test_counter_and_gauge_semantics(self):
+        reg = MetricsRegistry()
+        c = reg.counter("pio_shed_total", "t", labelnames=("reason",))
+        g = reg.gauge("pio_live", "t")
+        c.labels("x").inc(5)
+        g.set(3.0)
+        snap = reg.render_json()
+        c.labels("x").inc(2)
+        g.set(9.0)
+        delta = reg.delta_snapshot(snap)
+        assert delta["pio_shed_total"]["series"][0]["value"] == 2.0
+        # gauges are point-in-time: pass through, never subtract
+        assert delta["pio_live"]["series"][0]["value"] == 9.0
+
+    def test_counter_reset_clamps_to_zero(self):
+        """A restarted process resets counters; the window must degrade
+        to 'starts at restart', not go negative."""
+        prev = {
+            "pio_x_total": {
+                "type": "counter",
+                "series": [{"labels": {}, "value": 100.0}],
+            }
+        }
+        cur = {
+            "pio_x_total": {
+                "type": "counter",
+                "series": [{"labels": {}, "value": 10.0}],
+            }
+        }
+        out = subtract_snapshots(cur, prev)
+        assert out["pio_x_total"]["series"][0]["value"] == 0.0
+
+    def test_born_mid_window_series(self):
+        reg = MetricsRegistry()
+        c = reg.counter("pio_y_total", "t", labelnames=("k",))
+        c.labels("old").inc(4)
+        snap = reg.render_json()
+        c.labels("new").inc(7)  # series born after the boundary
+        delta = reg.delta_snapshot(snap)
+        by_label = {
+            s["labels"]["k"]: s["value"]
+            for s in delta["pio_y_total"]["series"]
+        }
+        assert by_label == {"old": 0.0, "new": 7.0}
+
+
+# ---------------------------------------------------------------------------
+# incident-bundle cooldown (env-tunable, suppression metered)
+# ---------------------------------------------------------------------------
+
+
+class TestIncidentCooldown:
+    def _recorder(self, tmp_path, reg, clock, **kw):
+        from predictionio_tpu.obs.disttrace import FragmentStore
+        from predictionio_tpu.obs.incident import IncidentRecorder
+
+        return IncidentRecorder(
+            str(tmp_path / "inc"),
+            registry=reg,
+            fragments=FragmentStore(),
+            clock=clock,
+            stack_burst_s=0.0,
+            **kw,
+        )
+
+    def _counter(self, reg, name):
+        fam = reg.get(name)
+        if fam is None:
+            return 0.0
+        return sum(c.value for _, c in fam.series())
+
+    def test_env_tuned_cooldown_frozen_clock(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PIO_INCIDENT_MIN_INTERVAL_S", "120")
+        now = [1000.0]
+        reg = MetricsRegistry()
+        rec = self._recorder(tmp_path, reg, lambda: now[0])
+        assert rec.min_interval_s == 120.0
+        assert rec.record({"rule": "slo_burn"}) is not None
+        now[0] += 119.0  # inside the window: suppressed, metered
+        assert rec.record({"rule": "slo_burn"}) is None
+        assert self._counter(reg, "pio_incidents_suppressed_total") == 1.0
+        now[0] += 2.0  # past the window: records again
+        assert rec.record({"rule": "slo_burn"}) is not None
+        assert self._counter(reg, "pio_incidents_recorded_total") == 2.0
+
+    def test_cooldown_is_per_rule(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("PIO_INCIDENT_MIN_INTERVAL_S", raising=False)
+        now = [0.0]
+        reg = MetricsRegistry()
+        rec = self._recorder(tmp_path, reg, lambda: now[0])
+        assert rec.min_interval_s == 60.0  # the documented default
+        assert rec.record({"rule": "breaker_open"}) is not None
+        # a DIFFERENT rule is not throttled by the first rule's window
+        assert rec.record({"rule": "ingest_shed"}) is not None
+        assert rec.record({"rule": "breaker_open"}) is None
+
+    def test_malformed_env_falls_back(self, monkeypatch):
+        from predictionio_tpu.obs.incident import min_interval_from_env
+
+        monkeypatch.setenv("PIO_INCIDENT_MIN_INTERVAL_S", "soon")
+        assert min_interval_from_env() == 60.0
+        monkeypatch.setenv("PIO_INCIDENT_MIN_INTERVAL_S", "0")
+        assert min_interval_from_env() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# the shared workload loops (BENCH extraction equivalence)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def tiny_server():
+    """A minimal /queries.json endpoint with the serving headers the
+    outcome log captures."""
+    from predictionio_tpu.server.httpd import AppServer, HTTPApp, Response
+
+    app = HTTPApp("replaytest")
+    hits = []
+
+    @app.route("POST", "/queries\\.json")
+    def q(req):
+        hits.append(req.json())
+        return Response(
+            200,
+            {"itemScores": []},
+            headers={
+                "X-Pio-Engine-Instance": "inst-1",
+                "X-Pio-Variant": "champion",
+                "X-Pio-Replica": "127.0.0.1:0",
+            },
+        )
+
+    server = AppServer(app, "127.0.0.1", 0).start_background()
+    try:
+        yield server, hits
+    finally:
+        server.shutdown()
+
+
+class TestWorkloadLoops:
+    def test_closed_loop_matches_async_client(self, tiny_server):
+        """Satellite check for the BENCH refactor: the extracted
+        sequential loop and the extracted asyncio client measure the same
+        server within a loose factor — same numbers BENCH printed before
+        the extraction, modulo scheduler noise."""
+        server, _ = tiny_server
+        seq = measure_closed_loop("127.0.0.1", server.port, 60, 5)
+        assert len(seq) == 60 and seq == sorted(seq)
+        rounds = run_load_rounds(server.port, 4, 15, 5, 2)
+        assert len(rounds) == 2
+        for r in rounds:
+            assert set(r) == {"p50_ms", "p99_ms"}
+        seq_p50 = seq[len(seq) // 2]
+        conc_p50 = min(r["p50_ms"] for r in rounds)
+        # generous envelope: both measure the same trivial handler; an
+        # extraction bug (wrong body, missed assert, per-request
+        # reconnect) shows up as orders of magnitude, not factors
+        assert seq_p50 < 100 and conc_p50 < 250
+        assert conc_p50 / seq_p50 < 50
+
+    def test_open_loop_runner_outcomes(self, tiny_server):
+        server, hits = tiny_server
+        sched = build_phase_schedule(
+            name="p0", index=0, start_s=0.0, duration_s=0.5, qps=40,
+            read_frac=1.0, num_entities=10, seed=3,
+        )
+        runner = OpenLoopRunner(
+            f"http://127.0.0.1:{server.port}", run="t", max_inflight=8
+        )
+        try:
+            outcomes = runner.run_phase(sched, time.monotonic())
+        finally:
+            runner.close()
+        assert len(outcomes) == len(sched) == 20
+        assert len({o["id"] for o in outcomes}) == 20
+        assert all(o["status"] == 200 for o in outcomes)
+        assert all(o["instance"] == "inst-1" for o in outcomes)
+        assert all(o["variant"] == "champion" for o in outcomes)
+        assert len(hits) == 20
+        # entity ids carry the prefix; num defaults to the runner's
+        assert all(h["user"].startswith("u") for h in hits)
+
+    def test_writes_route_to_event_url(self, tiny_server):
+        from predictionio_tpu.server.httpd import AppServer, HTTPApp, Response
+
+        server, _ = tiny_server
+        eapp = HTTPApp("events")
+        writes = []
+
+        @eapp.route("POST", "/events\\.json")
+        def ev(req):
+            writes.append(req.json())
+            return Response(201, {"eventId": "e"})
+
+        eserver = AppServer(eapp, "127.0.0.1", 0).start_background()
+        try:
+            sched = build_phase_schedule(
+                name="w", index=0, start_s=0.0, duration_s=0.5, qps=40,
+                read_frac=0.0, num_entities=6, seed=1,
+            )
+            runner = OpenLoopRunner(
+                f"http://127.0.0.1:{server.port}",
+                f"http://127.0.0.1:{eserver.port}",
+                "KEY",
+                run="t",
+            )
+            try:
+                outcomes = runner.run_phase(sched, time.monotonic())
+            finally:
+                runner.close()
+            assert all(o["kind"] == "write" for o in outcomes)
+            assert all(o["status"] == 201 for o in outcomes)
+            assert len(writes) == 20
+            assert all(w["event"] == "rate" for w in writes)
+        finally:
+            eserver.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# verdict engine
+# ---------------------------------------------------------------------------
+
+
+def _evidence(tmp_path, **over):
+    """A minimal all-green evidence pack the clause tests perturb."""
+    outcomes = [
+        {
+            "id": f"r-p0-{i}",
+            "phase": "p0",
+            "phase_index": 0,
+            "kind": "read",
+            "start_s": 0.1 * i,
+            "latency_ms": 5.0,
+            "status": 200,
+            "replica": "a",
+            "instance": "inst-old",
+            "variant": "champion",
+            "error": None,
+        }
+        for i in range(10)
+    ]
+    ev = {
+        "scenario": "unit",
+        "seed": 0,
+        "phases": [
+            {"name": "p0", "index": 0, "start_s": 0.0, "duration_s": 1.0,
+             "qps": 10, "read_frac": 1.0, "p99_ms": 100.0, "scheduled": 10}
+        ],
+        "outcomes": outcomes,
+        "snapshots": [],
+        "costs": [],
+        "injected": [],
+        "incident_dir": str(tmp_path / "inc"),
+        "incidents_after": 0.0,
+        "autoscaler": {"desired": 1, "actual": 1, "tolerance": 1},
+        "instances": {"known": ["inst-old"], "new": None,
+                      "flip_completed_s": None},
+    }
+    ev.update(over)
+    return ev
+
+
+def _clause(verdict, name):
+    return next(c for c in verdict["clauses"] if c["clause"] == name)
+
+
+def _write_bundle(tmp_path, rule, now=100.0, name=None):
+    d = tmp_path / "inc"
+    d.mkdir(exist_ok=True)
+    p = d / f"{name or rule}.json"
+    p.write_text(json.dumps({"rule": rule, "at": now, "now": now}))
+    return p
+
+
+class TestVerdict:
+    def test_all_green(self, tmp_path):
+        v = evaluate_day(_evidence(tmp_path))
+        assert v["pass"], render_verdict(v)
+        assert {c["clause"] for c in v["clauses"]} == {
+            "phase_p99_bounded", "exactly_once", "flip_coherence",
+            "autoscaler_converged", "fault_reconciliation",
+        }
+
+    def test_missing_bundle_fails_naming_rule(self, tmp_path):
+        ev = _evidence(
+            tmp_path,
+            injected=[{"kind": "kill_replica", "at_s": 1.0,
+                       "rule": "breaker_open"}],
+        )
+        v = evaluate_day(ev)
+        c = _clause(v, "fault_reconciliation")
+        assert not v["pass"] and not c["passed"]
+        assert c["evidence"]["missing"] == {"breaker_open": 1}
+
+    def test_exact_reconciliation_passes_with_bundle(self, tmp_path):
+        _write_bundle(tmp_path, "breaker_open")
+        ev = _evidence(
+            tmp_path,
+            injected=[{"kind": "kill_replica", "at_s": 1.0,
+                       "rule": "breaker_open"}],
+        )
+        c = _clause(evaluate_day(ev), "fault_reconciliation")
+        assert c["passed"]
+        # the clause carries the bundle path as evidence
+        assert c["evidence"]["bundles"]["breaker_open"][0].endswith(".json")
+
+    def test_duplicate_bundle_fails(self, tmp_path):
+        _write_bundle(tmp_path, "breaker_open", name="b1")
+        _write_bundle(tmp_path, "breaker_open", name="b2")
+        ev = _evidence(
+            tmp_path,
+            injected=[{"kind": "kill_replica", "at_s": 1.0,
+                       "rule": "breaker_open"}],
+        )
+        c = _clause(evaluate_day(ev), "fault_reconciliation")
+        assert not c["passed"] and "breaker_open" in c["evidence"]["duplicate"]
+
+    def test_spurious_bundle_fails(self, tmp_path):
+        _write_bundle(tmp_path, "slo_burn")
+        c = _clause(
+            evaluate_day(_evidence(tmp_path)), "fault_reconciliation"
+        )
+        assert not c["passed"] and "slo_burn" in c["evidence"]["spurious"]
+
+    def test_stale_bundle_filtered_by_after_stamp(self, tmp_path):
+        _write_bundle(tmp_path, "breaker_open", now=50.0)
+        ev = _evidence(tmp_path, incidents_after=60.0)
+        # the stale bundle predates the run: neither spurious nor counted
+        assert _clause(evaluate_day(ev), "fault_reconciliation")["passed"]
+
+    def test_duplicate_request_id_fails_exactly_once(self, tmp_path):
+        ev = _evidence(tmp_path)
+        ev["outcomes"].append(dict(ev["outcomes"][0]))
+        c = _clause(evaluate_day(ev), "exactly_once")
+        assert not c["passed"] and "r-p0-0" in c["evidence"]["duplicate_ids"]
+
+    def test_missing_outcome_fails_exactly_once(self, tmp_path):
+        ev = _evidence(tmp_path)
+        ev["outcomes"].pop()
+        c = _clause(evaluate_day(ev), "exactly_once")
+        assert not c["passed"] and c["evidence"]["missing_outcomes"] == 1
+
+    def test_write_shed_excused_only_in_stall_window(self, tmp_path):
+        shed = {
+            "id": "r-p0-w", "phase": "p0", "phase_index": 0,
+            "kind": "write", "start_s": 0.5, "latency_ms": 1.0,
+            "status": 503, "replica": None, "instance": None,
+            "variant": None, "error": None,
+        }
+        ev = _evidence(tmp_path, stall_windows=[[0.0, 1.0]])
+        ev["outcomes"].append(shed)
+        ev["phases"][0]["scheduled"] = 11
+        assert _clause(evaluate_day(ev), "exactly_once")["passed"]
+        ev2 = _evidence(tmp_path, stall_windows=[])
+        ev2["outcomes"].append(dict(shed))
+        ev2["phases"][0]["scheduled"] = 11
+        c = _clause(evaluate_day(ev2), "exactly_once")
+        assert not c["passed"] and "r-p0-w" in c["evidence"]["write_failures"]
+
+    def test_flip_coherence_catches_stale_generation(self, tmp_path):
+        ev = _evidence(tmp_path)
+        ev["instances"] = {
+            "known": ["inst-old", "inst-new"],
+            "new": "inst-new",
+            "flip_completed_s": 0.45,
+        }
+        v = _clause(evaluate_day(ev), "flip_coherence")
+        # outcomes after 0.45s still answer as inst-old: stale
+        assert not v["passed"]
+        assert v["evidence"]["exemplar_stale_after_flip"]
+
+    def test_flip_coherence_unknown_instance(self, tmp_path):
+        ev = _evidence(tmp_path)
+        ev["outcomes"][3]["instance"] = "who-dis"
+        c = _clause(evaluate_day(ev), "flip_coherence")
+        assert not c["passed"] and "r-p0-3" in c["evidence"]["exemplar_incoherent"]
+
+    def test_autoscaler_evidence_required(self, tmp_path):
+        ev = _evidence(tmp_path, autoscaler={"desired": None, "actual": 2,
+                                             "tolerance": 1})
+        c = _clause(evaluate_day(ev), "autoscaler_converged")
+        assert not c["passed"] and "missing" in c["detail"]
+
+    def test_autoscaler_tolerance(self, tmp_path):
+        ev = _evidence(tmp_path, autoscaler={"desired": 1, "actual": 3,
+                                             "tolerance": 1})
+        assert not _clause(evaluate_day(ev), "autoscaler_converged")["passed"]
+        ev["autoscaler"]["tolerance"] = 2
+        assert _clause(evaluate_day(ev), "autoscaler_converged")["passed"]
+
+    def test_p99_bound_from_outcome_log_fallback(self, tmp_path):
+        ev = _evidence(tmp_path)
+        ev["phases"][0]["p99_ms"] = 1.0  # every 5ms outcome violates
+        v = evaluate_day(ev)
+        c = _clause(v, "phase_p99_bounded")
+        assert not c["passed"]
+        assert c["evidence"]["violations"][0]["source"].startswith("outcome log")
+
+    def test_p99_bound_from_bucket_deltas(self, tmp_path):
+        """Telemetry is authoritative: per-phase p99 comes from histogram
+        bucket deltas between the phase-boundary snapshots."""
+        reg = MetricsRegistry()
+        h = reg.histogram(
+            "pio_router_forward_seconds", "t", labelnames=("replica",)
+        )
+        for _ in range(50):
+            h.labels("r1").observe(0.002)
+        snap0 = reg.render_json()
+        for _ in range(50):
+            h.labels("r1").observe(0.002)
+        for _ in range(3):
+            h.labels("r2").observe(0.9)  # the slow tail lives on r2
+        snap1 = reg.render_json()
+        ev = _evidence(tmp_path, snapshots=[snap0, snap1])
+        ev["phases"][0]["p99_ms"] = 50.0
+        v = evaluate_day(ev)
+        c = _clause(v, "phase_p99_bounded")
+        assert not c["passed"]
+        viol = c["evidence"]["violations"][0]
+        assert viol["source"].startswith("metric:pio_router_forward_seconds")
+        assert viol["p99_ms"] > 100.0
+        # the per-phase table aggregated both replicas' buckets
+        assert v["phases"][0]["telemetry_requests"] == 53
+
+    def test_render_verdict_readable(self, tmp_path):
+        ev = _evidence(
+            tmp_path,
+            injected=[{"kind": "kill_replica", "at_s": 1.0,
+                       "rule": "breaker_open"}],
+        )
+        text = render_verdict(evaluate_day(ev))
+        assert "VERDICT: FAIL" in text
+        assert "[FAIL] fault_reconciliation" in text
+        assert "breaker_open" in text
+        assert "p99ms" in text  # the phase table header
