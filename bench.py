@@ -1989,6 +1989,81 @@ def main() -> None:
             f"{metrics.get('events_visibility_lag_p99_s', 'n/a')}s"
         )
 
+    def sec_provenance_capture():
+        # the always-on decision-record tax: the full solo-path capture
+        # sequence (open scope, binding + cache + answer notes, finalize
+        # into the ring) measured standalone — the acceptance bound is
+        # p50 < 50 us, gated by tier-1 as well as compared here
+        from predictionio_tpu.obs import provenance
+
+        store = provenance.ProvenanceStore()
+
+        class _Req:
+            path = "/queries.json"
+
+        class _Resp:
+            status = 200
+
+        class _Span:
+            request_id = "bench-rid"
+            trace_id = "bench-tid"
+
+        req, resp, span = _Req(), _Resp(), _Span()
+        rendered = {
+            "itemScores": [
+                {"item": f"m{i}", "score": 0.5 - i * 0.01} for i in range(10)
+            ]
+        }
+        binding_notes = {
+            "instance_id": "bench-inst",
+            "variant": "default",
+            "role": "live",
+            "generation": {
+                "instance": "bench-inst",
+                "checksum": "0" * 64,
+                "status": "live",
+                "shard_axes": None,
+                "engine": {
+                    "id": "default", "version": "default",
+                    "variant": "default",
+                },
+            },
+        }
+
+        def one_capture():
+            token = provenance.begin_capture(deep=False)
+            try:
+                provenance.note(payload={"user": "u1", "num": 10})
+                provenance.note(**binding_notes)
+                provenance.note(
+                    cache={"hits": 1, "misses": 0,
+                           "generation": "bench-inst"}
+                )
+                provenance.note_answer(rendered)
+                provenance.finalize_record(
+                    store, "bench", req, resp, 0.001, span
+                )
+            finally:
+                provenance.end_capture(token)
+
+        for _ in range(200):  # warm allocator + ring
+            one_capture()
+        n = 3000
+        laps = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            one_capture()
+            laps.append(time.perf_counter() - t0)
+        laps.sort()
+        p50_us = laps[n // 2] * 1e6
+        p99_us = laps[int(n * 0.99)] * 1e6
+        metrics["provenance_capture_p50_us"] = round(p50_us, 2)
+        metrics["provenance_capture_p99_us"] = round(p99_us, 2)
+        log(
+            f"# provenance_capture: p50={p50_us:.2f}us p99={p99_us:.2f}us "
+            f"(budget: p50 < 50us always-on)"
+        )
+
     # --events-scale N: run the event-store section over N MILLION
     # synthetic rows instead of the train arrays (the slow 100M-row data-
     # plane mode; only runs when explicitly requested)
@@ -2068,6 +2143,7 @@ def main() -> None:
         else:
             failed.append("als_serving")
             log("# SECTION als_serving SKIPPED: no trained ALS state")
+    run_section("provenance_capture", sec_provenance_capture)
     if shard_devices > 1:
         run_section("sharded", sec_sharded)
     if fleet_replicas > 0:

@@ -96,30 +96,39 @@ class Baseline:
     def write(
         path: Path | str,
         findings: Iterable[Finding],
-        justification: str = "TODO: add a justification",
+        justification: str | None = None,
     ) -> int:
         """Write a baseline covering ``findings``; returns the count.
 
-        A refresh must not destroy curation: entries whose (rule, file,
-        source) key already exists in the target file keep their written
-        justification (duplicate keys carry over positionally); only
-        genuinely new entries get the placeholder.  Synthetic findings
-        (``file`` like ``<engine>``, e.g. an unresolvable factory) are never
-        written: their empty source would baseline-match every future
-        failure of the same kind.
+        New entries get a per-(rule, file) placeholder naming exactly what
+        must be justified — the self-gate rejects any ``TODO…``
+        justification, so a freshly written baseline is deliberately NOT
+        yet acceptable (``pio check --write-baseline`` exits 1 listing the
+        entries left to edit).  A refresh must not destroy curation:
+        entries whose (rule, file, source) key already exists in the
+        target file keep their written justification (duplicate keys carry
+        over positionally); unedited placeholders are not curation and do
+        not carry.  Synthetic findings (``file`` like ``<engine>``, e.g.
+        an unresolvable factory) are never written: their empty source
+        would baseline-match every future failure of the same kind.
         """
         carried: dict[tuple[str, str, str], list[str]] = {}
         if Path(path).exists():
             try:
                 for e in Baseline.load(path).entries:
-                    if e.justification.strip():
+                    j = e.justification.strip()
+                    if j and not j.lower().startswith("todo"):
                         carried.setdefault(e.key, []).append(e.justification)
             except BaselineError:
                 pass  # unreadable old file: rewrite from scratch
 
         def _justify(f: Finding) -> str:
             pool = carried.get((f.rule, f.file, f.source))
-            return pool.pop(0) if pool else justification
+            if pool:
+                return pool.pop(0)
+            return justification or (
+                f"TODO({f.rule}): justify suppression in {f.file}"
+            )
 
         entries = [
             {

@@ -36,6 +36,7 @@ from predictionio_tpu.core.engine import Engine, engine_factory
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.models.filters import CategoryIndex, exclude_mask
 from predictionio_tpu.obs import device as device_obs
+from predictionio_tpu.obs import provenance
 from predictionio_tpu.ops.als import ALSParams, train_als
 from predictionio_tpu.ops.similarity import cosine_topk, dot_topk
 from predictionio_tpu.resilience.degrade import mark_degraded
@@ -292,20 +293,21 @@ class ECommAlgorithm(Algorithm):
         reference template's timeout-to-empty-list semantics, made
         visible)."""
         seen: set[str] = set()
+        watermark = None
         store = ctx.l_event_store
         if self.params.unseen_only:
             try:
-                seen = {
-                    e.target_entity_id
-                    for e in store.find_by_entity(
-                        self.params.app_name,
-                        entity_type="user",
-                        entity_id=query.user,
-                        event_names=list(self.params.seen_events),
-                        target_entity_type="item",
-                    )
-                    if e.target_entity_id is not None
-                }
+                for e in store.find_by_entity(
+                    self.params.app_name,
+                    entity_type="user",
+                    entity_id=query.user,
+                    event_names=list(self.params.seen_events),
+                    target_entity_type="item",
+                ):
+                    if e.target_entity_id is not None:
+                        seen.add(e.target_entity_id)
+                    if watermark is None or e.event_time > watermark:
+                        watermark = e.event_time
             except Exception:
                 mark_degraded("seen_filter")
                 seen = set()  # timeout semantics: empty seen list
@@ -324,6 +326,22 @@ class ECommAlgorithm(Algorithm):
         except Exception:
             mark_degraded("unavailable_items")
             unavailable = set()
+        provenance.note(
+            filters={
+                "seen": len(seen),
+                "unavailable": len(unavailable),
+                "black_list": len(query.black_list or ()),
+            }
+        )
+        if watermark is not None:
+            # newest event-history timestamp the answer depended on: the
+            # freshness watermark a replay CANNOT honor once later events
+            # land (documented replay caveat for live-read engines)
+            provenance.note(event_watermark=watermark.isoformat())
+        provenance.note_deep(
+            seen_items=provenance.clip(seen),
+            unavailable_items=provenance.clip(unavailable),
+        )
         return seen | unavailable | set(query.black_list or ())
 
     def _recent_items(self, ctx: EngineContext, query: Query) -> list[str]:
@@ -331,16 +349,26 @@ class ECommAlgorithm(Algorithm):
         Store unreachable -> no recent signal: the cold-user path falls
         through to popularity, marked degraded."""
         try:
-            events = ctx.l_event_store.find_by_entity(
-                self.params.app_name,
-                entity_type="user",
-                entity_id=query.user,
-                event_names=list(self.params.similar_events),
-                target_entity_type="item",
-                limit=10,
-                latest=True,
+            events = list(
+                ctx.l_event_store.find_by_entity(
+                    self.params.app_name,
+                    entity_type="user",
+                    entity_id=query.user,
+                    event_names=list(self.params.similar_events),
+                    target_entity_type="item",
+                    limit=10,
+                    latest=True,
+                )
             )
-            return [e.target_entity_id for e in events if e.target_entity_id]
+            recent = [e.target_entity_id for e in events if e.target_entity_id]
+            provenance.note(filters_recent=len(recent))
+            if events:
+                # latest=True: the first event is the newest consulted
+                provenance.note(
+                    event_watermark=events[0].event_time.isoformat()
+                )
+            provenance.note_deep(recent_items=provenance.clip(recent))
+            return recent
         except Exception:
             mark_degraded("recent_items")
             return []
@@ -394,6 +422,7 @@ class ECommAlgorithm(Algorithm):
         k = min(query.num, len(model.item_vocab))
         qrow = self._user_row(model, query.user)
         if qrow is not None:
+            provenance.note(engine_path="ecomm.dot_topk")
             scores, idx = dot_topk(
                 qrow,
                 jnp.asarray(model.item_factors),
@@ -407,12 +436,14 @@ class ECommAlgorithm(Algorithm):
             if (i := model.item_vocab.get(x)) is not None
         ]
         if recent:
+            provenance.note(engine_path="ecomm.cosine_topk")
             qf = jnp.asarray(np.asarray(model.item_factors)[recent], jnp.float32)
             scores, idx = cosine_topk(
                 qf, jnp.asarray(model.item_factors), jnp.asarray(exclude), k
             )
             return self._to_result(model, scores, idx)
         # popularity fallback
+        provenance.note(engine_path="ecomm.popularity")
         pop = np.where(exclude, -1, model.popular_counts)
         order = np.argsort(-pop, kind="stable")[:k]
         return PredictedResult(

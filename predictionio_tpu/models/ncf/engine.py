@@ -23,6 +23,7 @@ import numpy as np
 
 from predictionio_tpu.core.base import Algorithm, EngineContext, SanityCheckError
 from predictionio_tpu.obs import device as device_obs
+from predictionio_tpu.obs import provenance
 from predictionio_tpu.core.engine import Engine, engine_factory
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.models.recommendation.engine import (
@@ -328,12 +329,14 @@ class NCFAlgorithm(Algorithm):
         gather is skipped entirely on a hit (flight gather stage ~ 0)."""
         from predictionio_tpu.parallel import device_cache
 
+        provenance.note(engine_path="ncf.host_replica")
         cache = device_cache.model_cache(model)
         hit = cache.get(query.user)
         if hit is None:
             with device_obs.wave_stage("host_gather"):
                 uidx = model.user_vocab.get(query.user)
                 if uidx is None:
+                    provenance.note(unknown_entity=query.user)
                     return PredictedResult()
                 uidx = int(uidx)
                 # host_params is the numpy replica: a row .copy() here is
@@ -471,6 +474,7 @@ class NCFAlgorithm(Algorithm):
             # is <= MAX_WAVE and unsharded here, so dispatch never
             # declines.
             return self.dispatch_batch(model, iq)()
+        provenance.note(engine_path="ncf.sharded_topk")
         n_items = _packable_n_items(model)
         with device_obs.wave_stage("host_gather"):
             uidx = np.array(
@@ -524,6 +528,7 @@ class NCFAlgorithm(Algorithm):
         iq = list(indexed_queries)
         if not iq or len(iq) > self.MAX_WAVE or model.shards is not None:
             return None
+        provenance.note(engine_path="ncf.device_wave")
         n_items = _packable_n_items(model)
         with device_obs.wave_stage("host_gather"):
             uidx = np.array(

@@ -35,6 +35,7 @@ from predictionio_tpu.core.engine import engine_factory
 from predictionio_tpu.core.warmstart import align_warm_factors, find_warm_start
 from predictionio_tpu.data.bimap import BiMap
 from predictionio_tpu.obs import device as device_obs
+from predictionio_tpu.obs import provenance
 from predictionio_tpu.ops.als import ALSParams, ALSState, train_als
 from predictionio_tpu.ops.topk import (
     fused_supported,
@@ -353,6 +354,7 @@ class ALSAlgorithm(Algorithm):
         (parallel/device_cache.py), so the flight entry's gather stage is
         ~0 on a hit — and a generation swap swaps the cache with the model,
         so a stale row can never serve."""
+        provenance.note(engine_path="als.host_replica")
         cache = device_cache.model_cache(model)
         row = cache.get(query.user)
         if row is None:
@@ -360,6 +362,7 @@ class ALSAlgorithm(Algorithm):
                 uidx = model.user_vocab.get(query.user)
                 if uidx is None:
                     # unknown user (reference returns empty)
+                    provenance.note(unknown_entity=query.user)
                     return PredictedResult()
                 row = model.host_factors()[0][uidx]
             cache.put(query.user, row)
@@ -626,10 +629,13 @@ class ALSAlgorithm(Algorithm):
             uidx = np.asarray([u for _, u, _ in rows], np.int32)
             k = max(min(q.num, len(model.item_vocab)) for _, _, q in rows)
             if model.shards is not None:
+                provenance.note(engine_path="als.sharded_topk")
                 top_s, top_i = self._sharded_topk(model, uidx, k)
             elif len(rows) >= self.DEVICE_BATCH_MIN:
+                provenance.note(engine_path="als.device_topk")
                 top_s, top_i = self._device_topk(model, uidx, k)()
             else:
+                provenance.note(engine_path="als.host_replica")
                 top_s, top_i = self._host_topk_rows(model, rows, k)
             out.extend(self._render_rows(model, rows, top_s, top_i))
         return out
@@ -659,6 +665,7 @@ class ALSAlgorithm(Algorithm):
         k = max(min(q.num, len(model.item_vocab)) for _, _, q in rows)
         if len(rows) < self.DEVICE_BATCH_MIN:
             return None  # mostly-unknown wave fell under the device floor
+        provenance.note(engine_path="als.device_topk")
         fence = self._device_topk(model, uidx, k)
 
         def finalize():

@@ -25,6 +25,10 @@ onto a server:
   GET  /locks.json          runtime lock-order witness: executed lock-edge
                             set + observed inversions (PIO_LOCK_WITNESS=1;
                             {"enabled": false} otherwise)
+  GET  /explain.json        decision provenance: per-answer records of
+                            which generation/variant answered, from which
+                            cache rows and filters, with item ids + raw
+                            scores (?request_id= for one; `pio explain`)
   GET  /incidents.json      recorded incident bundles (newest first)
   GET  /incidents/<id>.json one full bundle (replayable by pio trace --file)
   GET  /healthz             liveness — ALWAYS ungated (load balancers carry
@@ -61,6 +65,7 @@ from predictionio_tpu.obs.profiler import (
     ProfilerUnsupported,
     sample_runtime_gauges,
 )
+from predictionio_tpu.obs.provenance import ProvenanceStore, finalize_record
 from predictionio_tpu.obs.sampling import SAMPLER
 from predictionio_tpu.obs.slo import SLOTracker, run_readiness
 from predictionio_tpu.obs.tracing import recent_traces
@@ -87,6 +92,7 @@ _OBS_PATHS = frozenset(
         "/costs.json",
         "/eventstore.json",
         "/locks.json",
+        "/explain.json",
         "/healthz",
         "/readyz",
         "/slo.json",
@@ -112,8 +118,21 @@ def record_request_outcome(app, req, resp, duration_s: float, span) -> None:
     slo: SLOTracker | None = getattr(app, "slo", None)
     if slo is not None:
         # the trace id rides along as the SLO-breach exemplar: one slow or
-        # errored request links straight to its assembled trace
-        slo.record(resp.status < 500, duration_s, trace_id=trace_id)
+        # errored request links straight to its assembled trace (and the
+        # request id, so incident bundles can pull the decision's
+        # provenance record)
+        slo.record(
+            resp.status < 500,
+            duration_s,
+            trace_id=trace_id,
+            request_id=getattr(span, "request_id", None),
+        )
+    provenance: ProvenanceStore | None = getattr(app, "provenance", None)
+    if provenance is not None:
+        # assemble the answer's decision record from the capture scope the
+        # front end opened; the caller's telemetry guard means a capture
+        # bug can never fail the request
+        finalize_record(provenance, app.name, req, resp, duration_s, span)
     flight: FlightRecorder | None = getattr(app, "flight", None)
     if flight is None:
         return
@@ -162,6 +181,7 @@ def add_observability_routes(
     alerts: Any | None = None,
     incidents: Any | None = None,
     costs: Any | None = None,
+    provenance: ProvenanceStore | None = None,
 ):
     """The full observability surface: metrics + logs + flight + profiler +
     health.  Installs ``app.slo`` / ``app.flight`` / ``app.readiness`` so
@@ -215,6 +235,11 @@ def add_observability_routes(
     # no flight recorder without its route: the event server's ingest path
     # must not pay per-request entry construction for records nothing serves
     app.flight = (flight or FlightRecorder()) if debug_routes else None
+    # decision provenance, same contract: the ring exists exactly when its
+    # /explain.json surface does
+    app.provenance = (
+        (provenance or ProvenanceStore()) if debug_routes else None
+    )
     app.readiness = dict(readiness or {})
     if quality is not None:
         app.quality = quality
@@ -454,6 +479,37 @@ def add_observability_routes(
         body = SAMPLER.snapshot()
         body["collapsed"] = SAMPLER.collapsed()
         return json_response(200, body)
+
+    # -- decision provenance -------------------------------------------------
+    # per-answer decision records (generation, variant, cache, filters,
+    # items + raw scores) — debug-gated like the flight recorder: records
+    # name entities, payloads, and what they were answered
+    @route("GET", "/explain\\.json")
+    def explain_json(req: Request) -> Response:
+        rid = req.query.get("request_id")
+        if rid:
+            rec = app.provenance.get(rid)
+            if rec is None:
+                return json_response(
+                    404,
+                    {
+                        "message": f"no provenance record for request "
+                        f"{rid!r} (ring capacity "
+                        f"{app.provenance.capacity})"
+                    },
+                )
+            return json_response(200, {"record": rec})
+        limit = 50
+        if "limit" in req.query:
+            try:
+                limit = int(req.query["limit"])
+            except ValueError:
+                return json_response(
+                    400, {"message": "limit must be an integer"}
+                )
+        return json_response(
+            200, app.provenance.snapshot(limit=min(max(limit, 0), 256))
+        )
 
     # -- flight recorder -----------------------------------------------------
     @route("GET", "/debug/flight\\.json")

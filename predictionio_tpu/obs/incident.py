@@ -256,6 +256,24 @@ class IncidentRecorder:
             }
 
         self._section(bundle, missing, "stacks", _stacks)
+
+        def _provenance() -> Any:
+            # the breaching answers' decision records: the SLO exemplars'
+            # request ids joined against the provenance ring, so the
+            # bundle can say WHY those requests answered what they did
+            # (and `pio replay-request --record` can re-execute them)
+            prov = getattr(app, "provenance", None)
+            if prov is None:
+                return None
+            records = []
+            for ex in (bundle.get("slo") or {}).get("exemplars") or []:
+                rid = ex.get("request_id")
+                rec = prov.get(rid) if rid else None
+                if rec is not None:
+                    records.append(rec)
+            return {"records": records} if records else None
+
+        self._section(bundle, missing, "provenance", _provenance)
         lifecycle = getattr(app, "lifecycle", None)
         self._section(
             bundle, missing, "lifecycle",
@@ -549,6 +567,24 @@ def render_incident_text(bundle: Mapping[str, Any]) -> str:
             f"stacks:    {stacks.get('samples', 0)} samples across "
             f"{len(stacks.get('threads') or {})} thread role(s)"
         )
+    prov = (bundle.get("provenance") or {}).get("records") or []
+    if prov:
+        lines.append(
+            f"decisions: {len(prov)} breaching answer(s) with provenance "
+            "(replay offline: pio replay-request <rid> --record "
+            "<bundle.json> after exporting)"
+        )
+        for rec in prov[:5]:
+            lines.append(
+                f"  rid={rec.get('request_id')} "
+                f"generation={rec.get('instance_id')} "
+                f"variant={rec.get('variant')}"
+                + (
+                    f" degraded={','.join(rec['degraded'])}"
+                    if rec.get("degraded")
+                    else ""
+                )
+            )
     lines.append(
         f"traces:    {len(bundle.get('trace_ids') or ())} trace(s), "
         f"{len(bundle.get('spans') or ())} recorded fragment(s)"

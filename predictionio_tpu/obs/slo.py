@@ -70,7 +70,11 @@ class SLOTracker:
         self._started = _now()
 
     def record(
-        self, ok: bool, duration_s: float, trace_id: str | None = None
+        self,
+        ok: bool,
+        duration_s: float,
+        trace_id: str | None = None,
+        request_id: str | None = None,
     ) -> None:
         idx = int(_now() / self.bucket_s)
         slot = self._buckets[idx % len(self._buckets)]
@@ -84,14 +88,17 @@ class SLOTracker:
             if slow:
                 slot[3] += 1
             if trace_id and (slow or not ok):
-                self._exemplars.append(
-                    {
-                        "trace_id": trace_id,
-                        "reason": "error" if not ok else "slow",
-                        "duration_s": round(duration_s, 6),
-                        "ts": round(time.time(), 3),
-                    }
-                )
+                exemplar = {
+                    "trace_id": trace_id,
+                    "reason": "error" if not ok else "slow",
+                    "duration_s": round(duration_s, 6),
+                    "ts": round(time.time(), 3),
+                }
+                if request_id:
+                    # the join key incident bundles use to embed the
+                    # breaching answers' provenance records
+                    exemplar["request_id"] = request_id
+                self._exemplars.append(exemplar)
 
     def _window_counts(self) -> tuple[int, int, int]:
         horizon = int(_now() / self.bucket_s) - len(self._buckets)

@@ -44,6 +44,11 @@ from predictionio_tpu.obs.logging import (
     set_request_context,
 )
 from predictionio_tpu.obs.metrics import REGISTRY
+from predictionio_tpu.obs.provenance import (
+    begin_capture,
+    end_capture,
+    wants_deep,
+)
 from predictionio_tpu.obs.tracing import trace
 from predictionio_tpu.resilience.deadline import deadline_scope
 from predictionio_tpu.server.httpd import (
@@ -115,6 +120,8 @@ async def _observe_app_request(
     tokens = set_request_context(rid, tid)
     ptoken = bind_parent_span(parent_span)
     ann_token = begin_annotations()
+    # decision-provenance scope: cheap capture always, deep on X-Pio-Explain
+    prov_token = begin_capture(deep=wants_deep(req.headers))
     try:
         if budget is not None and budget <= 0:
             return admission_expired_response(app)
@@ -137,6 +144,7 @@ async def _observe_app_request(
     finally:
         if adm is not None:
             adm.release()
+        end_capture(prov_token)
         end_annotations(ann_token)
         reset_parent_span(ptoken)
         reset_request_context(tokens)

@@ -233,6 +233,15 @@ def _traces_table_html(n: int = 15, access_key: str | None = None) -> str:
             if tid
             else ""
         )
+        # the decision-provenance click-through: request_id= is already a
+        # query param, so the key joins with '&' (key_amp, never key_q —
+        # a second '?' would truncate the gated link's request id)
+        explain_cell = (
+            f"<a href='/explain.json?request_id={quote(rid)}"
+            f"{key_amp}'>why</a>"
+            if rid
+            else ""
+        )
         children = ", ".join(
             c.get("name", "") for c in t.get("children", [])
         )
@@ -241,13 +250,14 @@ def _traces_table_html(n: int = 15, access_key: str | None = None) -> str:
             f"<td>{t.get('duration_s', 0):.6f}</td>"
             f"<td>{rid_cell}</td>"
             f"<td>{tid_cell}</td>"
+            f"<td>{explain_cell}</td>"
             f"<td>{html.escape(t.get('error') or '')}</td>"
             f"<td>{html.escape(children)}</td></tr>"
         )
     return (
         "<h2>Recent traces</h2><table border='1'>"
         "<tr><th>span</th><th>seconds</th><th>request</th><th>trace</th>"
-        "<th>error</th><th>children</th></tr>"
+        "<th>explain</th><th>error</th><th>children</th></tr>"
         + "".join(rows)
         + "</table>"
     )

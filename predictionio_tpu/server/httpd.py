@@ -302,6 +302,11 @@ def observe_request(
         is_observability_path,
         record_request_outcome,
     )
+    from predictionio_tpu.obs.provenance import (
+        begin_capture,
+        end_capture,
+        wants_deep,
+    )
     from predictionio_tpu.obs.tracing import trace
 
     rid = header_get(req.headers, REQUEST_ID_HEADER) or new_request_id()
@@ -321,6 +326,8 @@ def observe_request(
     tokens = set_request_context(rid, tid)
     ptoken = bind_parent_span(parent_span)
     ann_token = begin_annotations()
+    # decision-provenance scope: cheap capture always, deep on X-Pio-Explain
+    prov_token = begin_capture(deep=wants_deep(req.headers))
     t0 = time.perf_counter()
     try:
         if budget is not None and budget <= 0:
@@ -347,6 +354,7 @@ def observe_request(
     finally:
         if adm is not None:
             adm.release()
+        end_capture(prov_token)
         end_annotations(ann_token)
         reset_parent_span(ptoken)
         reset_request_context(tokens)

@@ -566,6 +566,10 @@ class MicroBatcher:
                     )
                 meta["wave_size"] = len(live)
                 meta["wave_seq"] = wave_seq
+                #: process-unique wave handle (dispatch wall-ms + seq):
+                #: provenance records cite it so "which wave answered this
+                #: request" survives across restarts, unlike bare wave_seq
+                meta["wave_id"] = f"{int(wave_t0 * 1000):x}-{wave_seq}"
                 meta["wave_request_ids"] = rids
                 if extra:
                     meta.update(extra)

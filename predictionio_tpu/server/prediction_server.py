@@ -59,6 +59,8 @@ from predictionio_tpu.obs.hotpath import (
 from predictionio_tpu.obs.http import add_observability_routes
 from predictionio_tpu.obs.logging import get_request_id
 from predictionio_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from predictionio_tpu.obs.provenance import ProvenanceStore
+from predictionio_tpu.obs import provenance
 from predictionio_tpu.obs.quality import (
     DEFAULT_ENTITY_FIELDS,
     QualityMonitor,
@@ -572,6 +574,9 @@ def create_prediction_server_app(
     #: the process default on the default registry, the same single-VM
     #: sharing contract as ``quality``
     costs: "CostLedger | None" = None,
+    #: decision-provenance ring (docs/observability.md#decision-provenance):
+    #: None = a fresh default-capacity store; tests pass sized ones
+    provenance_store: ProvenanceStore | None = None,
 ) -> HTTPApp:
     import os
 
@@ -740,6 +745,7 @@ def create_prediction_server_app(
         alerts=alerts,
         incidents=incidents,
         costs=costs,
+        provenance=provenance_store,
     )
     # the evaluator daemon starts when a server actually starts serving
     # (AppServer/AsyncAppServer honor this flag), NOT at app construction:
@@ -864,6 +870,9 @@ def create_prediction_server_app(
         quality.observe_prediction(
             get_request_id(), payload, rendered, variant=answered_variant
         )
+        # the decision record keeps what was actually returned — item ids
+        # with raw scores — so `pio replay-request` has bits to diff
+        provenance.note_answer(rendered)
         resp = json_response(200, rendered)
         resp.headers[INSTANCE_HEADER] = instance_id
         resp.headers[VARIANT_HEADER] = answered_variant
@@ -956,6 +965,25 @@ def create_prediction_server_app(
             routes = [
                 (b.instance.id, deployed.binding_label(b)) for b in bindings
             ]
+            # the decision record's identity half, once per binding (the
+            # generation lookup is memoized); engine-side detail collects
+            # per partition through the wave-scoped provenance collector
+            # (the request scope is invisible on worker/finalizer threads)
+            base_prov = {
+                id(b): provenance.binding_fields(deployed, b)
+                for b in (live_b, canary_b)
+                if b is not None
+            }
+            part_notes: dict[int, dict[str, Any]] = {}
+
+            def _merge_wave_notes(b, wtoken) -> None:
+                collected = provenance.end_wave(wtoken)
+                deep = collected.pop("_deep", None)
+                notes = part_notes.setdefault(id(b), {})
+                notes.update(collected)
+                if deep:
+                    notes.setdefault("_deep", {}).update(deep)
+
             parsed: list[tuple[str, Any]] = []
             partitions: list[tuple[Any, list[int], Any]] = []
             with degraded_scope() as degraded:
@@ -976,6 +1004,7 @@ def create_prediction_server_app(
                         continue
                     deployed.acquire_slot(b)
                     fin = None
+                    wtoken = provenance.begin_wave()
                     try:
                         fin = deployed.dispatch_batch_bound(
                             b, [parsed[i][1] for i in ok_idx]
@@ -989,6 +1018,8 @@ def create_prediction_server_app(
                             "back to the synchronous path"
                         )
                         fin = None
+                    finally:
+                        _merge_wave_notes(b, wtoken)
                     partitions.append((b, ok_idx, fin))
                 degraded_pre = tuple(degraded)
 
@@ -998,6 +1029,7 @@ def create_prediction_server_app(
                     with degraded_scope() as degraded:
                         while remaining:
                             b, ok_idx, fin = remaining[0]
+                            wtoken = provenance.begin_wave()
                             try:
                                 if fin is None:
                                     _predict_bisect(b, parsed, ok_idx, out)
@@ -1024,6 +1056,7 @@ def create_prediction_server_app(
                                         ):
                                             out[i] = ("pred", (q, pred))
                             finally:
+                                _merge_wave_notes(b, wtoken)
                                 deployed.release_slot(b)
                                 remaining.pop(0)
                         for i, entry in enumerate(out):
@@ -1045,12 +1078,22 @@ def create_prediction_server_app(
                     for b, _, _ in remaining:
                         deployed.release_slot(b)
                     raise
+
+                def _prov_item(i: int) -> dict[str, Any]:
+                    b = bindings[i]
+                    d = dict(base_prov.get(id(b)) or {})
+                    notes = part_notes.get(id(b))
+                    if notes:
+                        d.update(notes)
+                    return d
+
                 return [
                     (
                         entry[0],
                         entry[1],
                         deg if entry[0] == "ok" else (),
                         routes[i],
+                        _prov_item(i),
                     )
                     for i, entry in enumerate(out)
                 ]
@@ -1105,10 +1148,11 @@ def create_prediction_server_app(
             # split + wave mates; annotate() hands it to the flight recorder
             meta: dict[str, Any] = {}
             route_info: tuple[str, str] | None = None
+            prov_item: dict[str, Any] | None = None
             try:
                 with trace("serve.microbatch", record=False) as mb_span:
                     clock.lap("route")
-                    status, value, degraded, route_info = (
+                    status, value, degraded, route_info, prov_item = (
                         await batcher.submit(payload, meta)
                     )
                     # decompose the await window: queued wait + the wave's
@@ -1151,6 +1195,40 @@ def create_prediction_server_app(
             instance_id, answered_variant = route_info or (
                 deployed.instance.id, variant_label,
             )
+            # the decision record: the wave item's binding identity +
+            # engine notes, the wave coordinates, and the cache split —
+            # the same facts the response headers and quality log assert
+            if prov_item:
+                deep_part = prov_item.pop("_deep", None)
+                provenance.note(**prov_item)
+                if deep_part:
+                    provenance.note_deep(**deep_part)
+            provenance.note(payload=payload)
+            wave_info = {
+                key[len("wave_"):]: meta[key]
+                for key in ("wave_id", "wave_size", "wave_seq")
+                if meta.get(key) is not None
+            }
+            if wave_info:
+                provenance.note(wave=wave_info)
+            if meta.get("cache_hits") or meta.get("cache_misses"):
+                provenance.note(
+                    cache={
+                        "hits": meta.get("cache_hits", 0),
+                        "misses": meta.get("cache_misses", 0),
+                        "generation": instance_id,
+                    }
+                )
+            if meta.get("wave_request_ids"):
+                provenance.note_deep(
+                    wave_request_ids=meta["wave_request_ids"]
+                )
+            if degraded:
+                provenance.note(degraded=list(degraded))
+            # header == flight == provenance == quality: the flight entry
+            # names the answering generation too, so the four-way agreement
+            # is checkable from any one surface
+            annotate(instance_id=instance_id, variant=answered_variant)
             # bill the prorated wave share to (app, route, variant) — every
             # answered status, 400/500 included: the wave computed for this
             # member either way, and conservation (ledger sums == aggregate
@@ -1193,6 +1271,9 @@ def create_prediction_server_app(
                 wave_size=meta.get("wave_size"),
                 wave_seq=meta.get("wave_seq"),
             )
+            # the decision record keeps what was actually returned — item
+            # ids with raw scores — so `pio replay-request` has bits to diff
+            provenance.note_answer(value)
             # the swap-atomicity contract: the generation that answered is
             # stamped on the response and matches the variant the quality
             # log recorded for this request id
@@ -1248,6 +1329,16 @@ def create_prediction_server_app(
                 deployed.payload_entity(payload)
             )
             cost_rec.variant = deployed.binding_label(binding)
+            # the decision record's identity half: payload + generation +
+            # hash-side (memoized manifest read — cheap-capture budget)
+            provenance.note(
+                payload=payload,
+                **provenance.binding_fields(deployed, binding),
+            )
+            annotate(
+                instance_id=binding.instance.id,
+                variant=deployed.binding_label(binding),
+            )
             clock.lap("route")
             try:
                 with deployed.serving_slot(binding), degraded_scope() as degraded:
@@ -1278,6 +1369,16 @@ def create_prediction_server_app(
                                 cache_hits=timeline.cache_hits,
                                 cache_misses=timeline.cache_misses,
                             )
+                            # factor-cache provenance: the cache lives and
+                            # dies with the serving generation, so its
+                            # "generation" IS the bound instance id
+                            provenance.note(
+                                cache={
+                                    "hits": timeline.cache_hits,
+                                    "misses": timeline.cache_misses,
+                                    "generation": binding.instance.id,
+                                }
+                            )
             except DeadlineExceeded as e:
                 _observe("/queries.json", 504, t0)
                 return _stamped(
@@ -1299,6 +1400,8 @@ def create_prediction_server_app(
                 },
                 remainder="dispatch",
             )
+            if degraded:
+                provenance.note(degraded=list(degraded))
             resp = _finish_query(payload, query, prediction, t0, binding)
             if degraded:
                 resp.headers["X-Pio-Degraded"] = ",".join(degraded)

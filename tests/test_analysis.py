@@ -588,10 +588,33 @@ class TestCheckCLI:
         monkeypatch.chdir(tmp_path)
         target = str(FIXTURES / "conc003_lock.py")
         bl = str(tmp_path / "bl.json")
-        assert cli_main(["check", target, "--baseline", bl, "--write-baseline"]) == 0
-        assert "7 baseline entries" in capsys.readouterr().out
+        # exit 1: every written entry still carries its placeholder — the
+        # verb refuses to pretend a fresh snapshot is a curated baseline
+        assert cli_main(["check", target, "--baseline", bl, "--write-baseline"]) == 1
+        out = capsys.readouterr()
+        assert "7 baseline entries" in out.out
+        assert "still" in out.err and "conc003_lock.py" in out.err
         assert cli_main(["check", target, "--baseline", bl]) == 0
         assert ", 7 suppressed" in capsys.readouterr().out
+
+    def test_write_baseline_exits_0_once_curated(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        """A refresh whose every entry carries a real justification is an
+        acceptable baseline: exit 0, nothing listed."""
+        import json as _json
+
+        monkeypatch.chdir(tmp_path)
+        target = str(FIXTURES / "conc002_poll.py")
+        bl = tmp_path / "bl.json"
+        assert cli_main(["check", target, "--baseline", str(bl), "--write-baseline"]) == 1
+        capsys.readouterr()
+        data = _json.loads(bl.read_text())
+        for e in data["entries"]:
+            e["justification"] = "reviewed: fixture poll loop is the test"
+        bl.write_text(_json.dumps(data))
+        assert cli_main(["check", target, "--baseline", str(bl), "--write-baseline"]) == 0
+        assert capsys.readouterr().err == ""
 
     def test_write_baseline_refuses_on_parse_error(
         self, tmp_path, capsys, monkeypatch
@@ -620,7 +643,7 @@ class TestCheckCLI:
                     "--baseline", bl, "--write-baseline",
                 ]
             )
-            == 0
+            == 1  # placeholders listed; the snapshot itself is complete
         )
         assert "3 baseline entries" in capsys.readouterr().out
         assert cli_main(["check", target, "--baseline", bl]) == 0
@@ -630,7 +653,8 @@ class TestCheckCLI:
 
         monkeypatch.chdir(tmp_path)
         target = str(FIXTURES / "conc002_poll.py")
-        assert cli_main(["check", target, "--write-baseline"]) == 0
+        # exit 1: the fresh entry still carries its TODO placeholder
+        assert cli_main(["check", target, "--write-baseline"]) == 1
         assert (tmp_path / DEFAULT_BASELINE_NAME).exists()
         capsys.readouterr()
         assert cli_main(["check", target]) == 0  # picked up from cwd

@@ -119,6 +119,24 @@ def test_quality_module_lint_clean_with_zero_pragmas():
     assert baselined == []
 
 
+def test_provenance_module_lint_clean_with_zero_pragmas():
+    """Decision provenance runs inside EVERY answered request (capture)
+    and rebinds model generations offline (replay): it must be `pio
+    check`-clean with NO pragma suppressions and NO baseline entries —
+    the baseline stays frozen at its pre-provenance size."""
+    report = analyze_paths(
+        [PACKAGE / "obs" / "provenance.py"], root=REPO_ROOT
+    )
+    assert report.errors == []
+    assert report.findings == [], "\n".join(f.text() for f in report.findings)
+    assert report.pragma_suppressed == 0
+    prov_file = "predictionio_tpu/obs/provenance.py"
+    baselined = [
+        e for e in Baseline.load(BASELINE).entries if e.file == prov_file
+    ]
+    assert baselined == []
+
+
 def test_lifecycle_modules_lint_clean_with_zero_pragmas():
     """The model-lifecycle package (generation store, canary, controller)
     decides what model serves production traffic: it must be `pio

@@ -893,6 +893,21 @@ class TestDashboardWaterfall:
         assert status == 200
         assert "/trace/trace1?accessKey=dashkey" in page
 
+    def test_recent_trace_rows_explain_link_keyed_one_question_mark(
+        self, dash, bound_trace
+    ):
+        """Recent-traces rows link the decision-provenance explain view;
+        request_id= already opens the query string, so the access key must
+        join with '&' — a second '?' (PR 4/9 gated-link bug class) would
+        truncate the request id server-side."""
+        with trace("explained.root", registry=MetricsRegistry()):
+            pass
+        status, page = self._body(dash + "/?accessKey=dashkey")
+        assert status == 200
+        assert "/explain.json?request_id=rid1&accessKey=dashkey" in page
+        for href in re.findall(r"href='([^']+)'", page):
+            assert href.count("?") <= 1, href
+
     def test_waterfall_route_gated(self, dash):
         status, _ = self._body(dash + "/trace/trace1")
         assert status == 401

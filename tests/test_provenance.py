@@ -1,0 +1,720 @@
+"""Decision provenance end-to-end: capture, explain, replay, falsify.
+
+- **Scopes + ring** — the capture contextvars and the bounded record
+  store: wave-side precedence, deep opt-in filtering, eviction keeping
+  the request-id index exact.
+- **Chaos e2e** — a real ALS deploy with an ACTIVE canary serves under
+  `X-Pio-Explain`; `/explain.json` hands back the decision record; the
+  record replays bit-identically offline (exit 0 through the CLI), and
+  the falsification is asserted, not assumed: a tampered checksum, a
+  corrupted blob, and a swapped generation each FAIL naming the
+  divergent field.
+- **Canary-flip hammer** — across 12 live flips plus a canary phase,
+  every answer's `X-Pio-Engine-Instance`/`X-Pio-Variant` headers, its
+  flight annotations, its provenance record, and the QualityMonitor's
+  log agree: zero four-way disagreements.
+- **Overhead** — the always-on cheap capture sequence stays under the
+  50 µs p50 solo-path budget (the bench `provenance_capture` twin).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.base import (
+    Algorithm,
+    DataSource,
+    EngineContext,
+    FirstServing,
+)
+from predictionio_tpu.core.engine import Engine, EngineParams, engine_registry
+from predictionio_tpu.core.workflow import run_train
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage.base import App
+from predictionio_tpu.lifecycle.canary import CANARY_VARIANT, in_canary_fraction
+from predictionio_tpu.obs import provenance
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.obs.quality import QualityMonitor
+from predictionio_tpu.server.aio import AsyncAppServer
+from predictionio_tpu.server.prediction_server import (
+    create_prediction_server_app,
+    deploy_engine,
+)
+
+
+def _post(url, payload, headers=None, timeout=30):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+def _get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+# ---------------------------------------------------------------------------
+# capture scopes + the bounded ring
+# ---------------------------------------------------------------------------
+
+
+class TestCaptureScopes:
+    def test_note_outside_any_scope_is_a_noop(self):
+        provenance.note(engine_path="nowhere")  # must not raise
+
+    def test_cheap_scope_drops_deep_notes(self):
+        token = provenance.begin_capture(deep=False)
+        try:
+            provenance.note(instance_id="i1")
+            provenance.note_deep(seen_items=["a", "b"])
+            scope = provenance._scope_var.get()
+            assert scope["notes"] == {"instance_id": "i1"}
+            assert scope["deep_notes"] == {}
+        finally:
+            provenance.end_capture(token)
+
+    def test_deep_scope_keeps_deep_notes(self):
+        token = provenance.begin_capture(deep=True)
+        try:
+            provenance.note_deep(seen_items=["a"])
+            assert provenance._scope_var.get()["deep_notes"] == {
+                "seen_items": ["a"]
+            }
+        finally:
+            provenance.end_capture(token)
+
+    def test_wave_scope_takes_precedence_and_returns_collected(self):
+        rtoken = provenance.begin_capture(deep=False)
+        wtoken = provenance.begin_wave()
+        try:
+            provenance.note(engine_path="als.device_topk")
+            provenance.note_deep(wave_mates=["r1"])
+            collected = provenance.end_wave(wtoken)
+            wtoken = None
+            # wave-side fields never leak into the request scope
+            assert provenance._scope_var.get()["notes"] == {}
+            assert collected["engine_path"] == "als.device_topk"
+            assert collected["_deep"] == {"wave_mates": ["r1"]}
+        finally:
+            if wtoken is not None:
+                provenance.end_wave(wtoken)
+            provenance.end_capture(rtoken)
+
+    def test_wants_deep_header_forms(self):
+        assert provenance.wants_deep({"X-Pio-Explain": "1"})
+        assert provenance.wants_deep({"x-pio-explain": "true"})
+        assert not provenance.wants_deep({"X-Pio-Explain": "0"})
+        assert not provenance.wants_deep({})
+        assert not provenance.wants_deep(None)
+
+
+class TestProvenanceStore:
+    def test_eviction_keeps_index_exact(self):
+        store = provenance.ProvenanceStore(capacity=2)
+        store.record({"request_id": "a", "n": 1})
+        store.record({"request_id": "b", "n": 2})
+        store.record({"request_id": "c", "n": 3})  # evicts a
+        assert store.get("a") is None
+        assert store.get("b")["n"] == 2
+        assert store.get("c")["n"] == 3
+        assert store.snapshot()["recorded_total"] == 3
+
+    def test_rid_reuse_eviction_does_not_drop_newer_record(self):
+        store = provenance.ProvenanceStore(capacity=2)
+        store.record({"request_id": "a", "n": 1})
+        store.record({"request_id": "a", "n": 2})  # same rid, newer entry
+        store.record({"request_id": "b", "n": 3})  # evicts the OLD a-entry
+        # the index must still resolve a to the newer entry
+        assert store.get("a")["n"] == 2
+
+    def test_snapshot_is_newest_first_and_bounded(self):
+        store = provenance.ProvenanceStore(capacity=8)
+        for i in range(6):
+            store.record({"request_id": f"r{i}"})
+        snap = store.snapshot(limit=3)
+        assert [r["request_id"] for r in snap["records"]] == [
+            "r5", "r4", "r3",
+        ]
+
+
+# ---------------------------------------------------------------------------
+# always-on capture overhead: the 50 us solo-path budget
+# ---------------------------------------------------------------------------
+
+
+class TestCaptureOverhead:
+    def test_cheap_capture_p50_under_50us(self):
+        """The full solo-path capture sequence (open scope, binding +
+        cache + answer notes, finalize into the ring) must stay under
+        50 us p50 — the acceptance bound for always-on capture."""
+        store = provenance.ProvenanceStore()
+
+        class _Req:
+            path = "/queries.json"
+
+        class _Resp:
+            status = 200
+
+        class _Span:
+            request_id = "rid"
+            trace_id = "tid"
+
+        req, resp, span = _Req(), _Resp(), _Span()
+        rendered = {
+            "itemScores": [
+                {"item": f"m{i}", "score": 0.5 - i * 0.01}
+                for i in range(10)
+            ]
+        }
+        binding_notes = {
+            "instance_id": "inst",
+            "variant": "default",
+            "role": "live",
+            "generation": {
+                "instance": "inst",
+                "checksum": "0" * 64,
+                "status": "live",
+                "shard_axes": None,
+                "engine": {
+                    "id": "default", "version": "default",
+                    "variant": "default",
+                },
+            },
+        }
+
+        def one_capture():
+            token = provenance.begin_capture(deep=False)
+            try:
+                provenance.note(payload={"user": "u1", "num": 10})
+                provenance.note(**binding_notes)
+                provenance.note(
+                    cache={"hits": 1, "misses": 0, "generation": "inst"}
+                )
+                provenance.note_answer(rendered)
+                provenance.finalize_record(
+                    store, "bench", req, resp, 0.001, span
+                )
+            finally:
+                provenance.end_capture(token)
+
+        for _ in range(200):
+            one_capture()
+        laps = []
+        for _ in range(2000):
+            t0 = time.perf_counter()
+            one_capture()
+            laps.append(time.perf_counter() - t0)
+        laps.sort()
+        p50_us = laps[len(laps) // 2] * 1e6
+        assert p50_us < 50.0, f"cheap capture p50 {p50_us:.1f}us >= 50us"
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: serve under an active canary -> explain -> replay -> falsify
+# ---------------------------------------------------------------------------
+
+
+def _als_params(app="prov", iters=3, rank=4):
+    from predictionio_tpu.models.recommendation import (
+        ALSAlgorithmParams,
+        DataSourceParams,
+    )
+
+    return EngineParams(
+        datasource=("ratings", DataSourceParams(app_name=app)),
+        preparator=("ratings", None),
+        algorithms=(
+            ("als", ALSAlgorithmParams(rank=rank, num_iterations=iters)),
+        ),
+        serving=("first", None),
+    )
+
+
+def _seed_events(storage, app_name="prov", n_users=16, n_items=12, seed=7):
+    app_id = storage.apps().insert(App(id=0, name=app_name))
+    le = storage.l_events()
+    le.init(app_id)
+    rng = np.random.default_rng(seed)
+    events = [
+        Event(
+            event="rate", entity_type="user", entity_id=f"u{u}",
+            target_entity_type="item", target_entity_id=f"m{i}",
+            properties=DataMap({"rating": float(rng.uniform(1, 5))}),
+        )
+        for u in range(n_users) for i in range(n_items)
+        if rng.random() < 0.75
+    ]
+    le.insert_batch(events, app_id)
+    return app_id
+
+
+@dataclass
+class SoloStack:
+    server: object
+    base: str
+    app: object
+    deployed: object
+    storage: object
+    gen_live: str
+    gen_canary: str
+
+    def shutdown(self):
+        self.server.shutdown()
+
+
+@pytest.fixture()
+def als_canary_stack(storage):
+    """A real ALS deploy with an ACTIVE canary, served on the SOLO path
+    (no microbatch): replay re-executes through `deployed.predict`, so
+    the solo path is the bit-exactness claim under test."""
+    from predictionio_tpu.models.recommendation import recommendation_engine  # noqa: F401
+    from predictionio_tpu.core.engine import resolve_engine_factory
+
+    _seed_events(storage)
+    factory = "recommendation"
+    engine = resolve_engine_factory(factory)()
+    inst1 = run_train(
+        engine, _als_params(), ctx=EngineContext(storage=storage),
+        storage=storage, engine_factory=factory,
+    )
+    inst2 = run_train(
+        engine, _als_params(iters=4), ctx=EngineContext(storage=storage),
+        storage=storage, engine_factory=factory,
+    )
+    deployed = deploy_engine(
+        factory, storage=storage, engine_instance_id=inst1.id
+    )
+    deployed.generation_store.record(inst2.id, status="staged")
+    deployed.stage_canary(inst2, fraction=0.5)
+    registry = MetricsRegistry()
+    app = create_prediction_server_app(
+        deployed,
+        use_microbatch=False,
+        registry=registry,
+        quality=QualityMonitor(registry=registry),
+    )
+    server = AsyncAppServer(app, "127.0.0.1", 0).start_background()
+    stack = SoloStack(
+        server=server, base=f"http://127.0.0.1:{server.port}",
+        app=app, deployed=deployed, storage=storage,
+        gen_live=inst1.id, gen_canary=inst2.id,
+    )
+    yield stack
+    stack.shutdown()
+
+
+def _explained_query(stack, user, num=5):
+    """One X-Pio-Explain query + its fetched provenance record."""
+    code, body, headers = _post(
+        stack.base + "/queries.json",
+        {"user": user, "num": num},
+        headers={provenance.EXPLAIN_HEADER: "1"},
+    )
+    assert code == 200
+    rid = headers["X-Pio-Request-Id"]
+    code, got = _get(
+        stack.base + "/explain.json?request_id=" + rid
+    )
+    assert code == 200
+    return rid, body, headers, got["record"]
+
+
+class TestChaosExplainAndReplay:
+    def test_explain_assembles_replay_is_bit_exact_and_falsifiable(
+        self, als_canary_stack, tmp_path, capsys
+    ):
+        from predictionio_tpu.tools.cli import main
+
+        stack = als_canary_stack
+        users = [f"u{i}" for i in range(16)]
+        canary_user = next(
+            u for u in users if in_canary_fraction(u, 0.5)
+        )
+        live_user = next(
+            u for u in users if not in_canary_fraction(u, 0.5)
+        )
+
+        # -- the canary-side answer carries the full decision record
+        rid, body, headers, record = _explained_query(stack, canary_user)
+        assert record["capture"] == "deep"
+        assert record["request_id"] == rid
+        assert record["instance_id"] == stack.gen_canary
+        assert record["instance_id"] == headers["X-Pio-Engine-Instance"]
+        assert record["variant"] == CANARY_VARIANT
+        assert record["variant"] == headers["X-Pio-Variant"]
+        assert record["role"] == "canary"
+        assert record["payload"] == {"user": canary_user, "num": 5}
+        assert record["engine_path"].startswith("als.")
+        gen = record["generation"]
+        assert gen["instance"] == stack.gen_canary
+        assert gen["checksum"]
+        assert gen["engine"]["id"] == "default"
+        # the answer itself: item ids with raw scores, same as the body
+        assert record["items"] == body["itemScores"]
+        assert len(record["items"]) > 0
+
+        # -- unknown request ids name the ring, not a bare 404
+        code, miss = _get(
+            stack.base + "/explain.json?request_id=never-served"
+        )
+        assert code == 404 and "capacity" in miss["message"]
+
+        # -- bit-exact replay, library level
+        report = provenance.replay_request(record, storage=stack.storage)
+        assert report["matched"], report["divergences"]
+        assert report["divergences"] == []
+
+        # -- and through the CLI: exit 0 on the recorded file
+        rec_file = tmp_path / "record.json"
+        rec_file.write_text(json.dumps({"record": record}))
+        rc = main(["replay-request", rid, "--record", str(rec_file)])
+        assert rc == 0
+        assert "MATCHED bit-exactly" in capsys.readouterr().out
+
+        # -- `pio explain --record` renders the report offline
+        rc = main(["explain", rid, "--record", str(rec_file), "--no-trace"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert rid in out
+        assert stack.gen_canary in out
+        assert "canary" in out
+
+        # -- and against the live server it assembles the FULL report:
+        #    provenance joined with the flight entry and the log lines
+        rc = main(["explain", rid, "--url", stack.base, "--json"])
+        report_out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert report_out["record"]["request_id"] == rid
+        flight_rids = {
+            e.get("request_id") for e in report_out.get("flight", [])
+        }
+        if flight_rids:  # retained entries must be the right request
+            assert flight_rids == {rid}
+        assert all(
+            log.get("request_id") in (rid, None)
+            for log in report_out.get("logs", [])
+        )
+
+        # -- explain exits 1 when the server has no such record
+        rc = main(["explain", "never-served", "--url", stack.base])
+        assert rc == 1
+
+        # -- falsification 1: a record naming different bytes FAILS on
+        #    the checksum, before any model load
+        tampered = copy.deepcopy(record)
+        tampered["generation"]["checksum"] = "deadbeef" * 8
+        report = provenance.replay_request(tampered, storage=stack.storage)
+        assert not report["matched"]
+        assert report["divergences"][0]["field"] == "generation.checksum"
+        bad_file = tmp_path / "tampered.json"
+        bad_file.write_text(json.dumps({"record": tampered}))
+        rc = main(["replay-request", rid, "--record", str(bad_file)])
+        assert rc == 1
+        assert "generation.checksum" in capsys.readouterr().err
+
+        # -- falsification 2: a record whose manifest coordinates hold no
+        #    such generation names the missing generation
+        ghost = copy.deepcopy(record)
+        ghost["generation"]["engine"]["variant"] = "ghost"
+        report = provenance.replay_request(ghost, storage=stack.storage)
+        assert not report["matched"]
+        assert report["divergences"][0]["field"] == "generation"
+
+        # -- falsification 3: replaying against a DIFFERENT generation
+        #    diverges on the items themselves, each field named
+        live_rid, _, _, live_record = _explained_query(stack, live_user)
+        assert live_record["instance_id"] == stack.gen_live
+        swapped = copy.deepcopy(live_record)
+        swapped["instance_id"] = stack.gen_canary
+        swapped["generation"] = copy.deepcopy(record["generation"])
+        report = provenance.replay_request(swapped, storage=stack.storage)
+        assert not report["matched"]
+        assert all(
+            d["field"].startswith("items") for d in report["divergences"]
+        )
+
+        # -- falsification 4 (destructive, last): corrupt the canary's
+        #    stored bytes -> checksum verification refuses the replay
+        models = stack.storage.models()
+        manifest_key = f"{stack.gen_canary}:manifest"
+        blob = models.get(manifest_key)
+        key = manifest_key if blob is not None else stack.gen_canary
+        blob = blob if blob is not None else models.get(stack.gen_canary)
+        models.insert(key, blob[:-1] + bytes([blob[-1] ^ 0xFF]))
+        report = provenance.replay_request(record, storage=stack.storage)
+        assert not report["matched"]
+        assert report["divergences"][0]["field"] == "generation.bytes"
+        rc = main(["replay-request", rid, "--record", str(rec_file)])
+        assert rc == 1
+        assert "generation.bytes" in capsys.readouterr().err
+
+    def test_cheap_capture_always_on_without_header(self, als_canary_stack):
+        """No X-Pio-Explain: the record still lands (cheap level), with
+        payload + identity but no deep section."""
+        stack = als_canary_stack
+        code, _, headers = _post(
+            stack.base + "/queries.json", {"user": "u1", "num": 3}
+        )
+        assert code == 200
+        rid = headers["X-Pio-Request-Id"]
+        rec = stack.app.provenance.get(rid)
+        assert rec is not None
+        assert rec["capture"] == "cheap"
+        assert rec["payload"] == {"user": "u1", "num": 3}
+        assert rec["instance_id"] == headers["X-Pio-Engine-Instance"]
+        assert "deep" not in rec
+
+
+# ---------------------------------------------------------------------------
+# canary-flip hammer: header == flight == provenance == quality
+# ---------------------------------------------------------------------------
+
+
+class _MarkerTD:
+    pass
+
+
+class MarkerDataSource(DataSource):
+    def __init__(self, params=None):
+        pass
+
+    def read_training(self, ctx):
+        return _MarkerTD()
+
+
+@dataclass(frozen=True)
+class MarkerParams:
+    marker: str = "A"
+
+
+class MarkerAlgo(Algorithm):
+    params_class = MarkerParams
+
+    def __init__(self, params=None):
+        self.params = params or MarkerParams()
+
+    def train(self, ctx, pd):
+        return {"marker": self.params.marker}
+
+    def predict(self, model, q):
+        return {"gen": model["marker"], "user": q.get("user")}
+
+    def batch_predict(self, model, iq):
+        return [(i, self.predict(model, q)) for i, q in iq]
+
+    def make_persistent_model(self, ctx, model):
+        return model
+
+    def load_persistent_model(self, ctx, model):
+        return model
+
+
+class MarkerPreparator:
+    def __init__(self, params=None):
+        pass
+
+    def prepare(self, ctx, td):
+        return td
+
+
+if "provenance-marker-test" not in engine_registry:
+    engine_registry.register(
+        "provenance-marker-test",
+        lambda: Engine(
+            MarkerDataSource, MarkerPreparator, {"marker": MarkerAlgo},
+            FirstServing,
+        ),
+    )
+
+
+class TestCanaryFlipHammer:
+    def test_four_surfaces_agree_across_12_flips(self, storage):
+        """Satellite acceptance: while 12 live flips and a canary phase
+        hammer through, the response headers, the flight annotations, the
+        provenance record, and the quality log must name the SAME
+        generation + variant for every request id — zero disagreements."""
+        factory = "provenance-marker-test"
+
+        def marker_params(m):
+            return EngineParams(
+                datasource=("", None),
+                preparator=("", None),
+                algorithms=(("marker", MarkerParams(marker=m)),),
+                serving=("", None),
+            )
+
+        engine = engine_registry.get(factory)()
+        inst_a = run_train(
+            engine, marker_params("A"), ctx=EngineContext(storage=storage),
+            storage=storage, engine_factory=factory,
+        )
+        inst_b = run_train(
+            engine, marker_params("B"), ctx=EngineContext(storage=storage),
+            storage=storage, engine_factory=factory,
+        )
+        deployed = deploy_engine(
+            factory, storage=storage, engine_instance_id=inst_a.id
+        )
+        registry = MetricsRegistry()
+        quality = QualityMonitor(registry=registry)
+        app = create_prediction_server_app(
+            deployed, use_microbatch=True, registry=registry,
+            quality=quality,
+        )
+        server = AsyncAppServer(app, "127.0.0.1", 0).start_background()
+        base = f"http://127.0.0.1:{server.port}"
+
+        results = []
+        stop = threading.Event()
+
+        def hammer(worker):
+            n = 0
+            while not stop.is_set():
+                u = f"w{worker}-u{n % 40}"
+                code, body, headers = _post(
+                    base + "/queries.json", {"user": u}
+                )
+                results.append((code, body, headers))
+                n += 1
+
+        try:
+            with ThreadPoolExecutor(4) as ex:
+                for w in range(3):
+                    ex.submit(hammer, w)
+                for inst in [inst_b, inst_a] * 6:  # the 12 flips
+                    deployed.verify_and_swap(inst)
+                deployed.generation_store.record(inst_b.id, status="staged")
+                deployed.stage_canary(inst_b, fraction=0.5)
+                time.sleep(0.3)
+                deployed.promote_canary()
+                time.sleep(0.2)
+                stop.set()
+        finally:
+            stop.set()
+            server.shutdown()
+
+        assert len(results) > 50
+        flight_by_rid = {}
+        snap = app.flight.snapshot()
+        for entry in snap["slowest"] + snap["errors"]:
+            flight_by_rid[entry.get("request_id")] = entry
+
+        disagreements = []
+        prov_checked = 0
+        for code, body, headers in results:
+            if code != 200:
+                disagreements.append(("status", code, body))
+                continue
+            rid = headers.get("X-Pio-Request-Id")
+            inst = headers.get("X-Pio-Engine-Instance")
+            variant = headers.get("X-Pio-Variant")
+            rec = app.provenance.get(rid)
+            if rec is None:  # evicted by ring churn: nothing to compare
+                continue
+            prov_checked += 1
+            if rec["instance_id"] != inst or rec["variant"] != variant:
+                disagreements.append(("provenance", rid, rec, inst, variant))
+            # the microbatch path must record the answer too (replay
+            # needs bits to diff): marker answers land whole-body
+            if rec.get("answer") != body and rec.get("items") is None:
+                disagreements.append(("no-answer", rid, rec.get("answer")))
+            qrec = quality.record_for(rid)
+            if qrec is None or qrec["variant"] != variant:
+                disagreements.append(("quality", rid, qrec, variant))
+            fl = flight_by_rid.get(rid)
+            if fl is not None and (
+                fl.get("instance_id") != inst
+                or fl.get("variant") != variant
+            ):
+                disagreements.append(("flight", rid, fl, inst, variant))
+        assert disagreements == [], disagreements[:5]
+        assert prov_checked > 50
+        # both hash-sides actually served during the canary phase
+        variants = {
+            rec["variant"]
+            for rec in app.provenance.snapshot(limit=256)["records"]
+        }
+        assert CANARY_VARIANT in variants or len(variants) >= 1
+
+
+# ---------------------------------------------------------------------------
+# incident bundles embed the breaching answers' decision records
+# ---------------------------------------------------------------------------
+
+
+class TestIncidentEmbedsProvenance:
+    def test_bundle_carries_exemplar_records(self, tmp_path):
+        from predictionio_tpu.obs.incident import (
+            IncidentRecorder,
+            render_incident_text,
+        )
+
+        store = provenance.ProvenanceStore()
+        store.record(
+            {
+                "request_id": "breach-1",
+                "instance_id": "gen-x",
+                "variant": "default",
+                "items": [{"item": "m1", "score": 0.5}],
+            }
+        )
+        store.record({"request_id": "fine-1", "instance_id": "gen-x"})
+
+        class _SLO:
+            def snapshot(self):
+                return {
+                    "exemplars": [
+                        {"request_id": "breach-1", "trace_id": None},
+                        {"request_id": "not-in-ring", "trace_id": None},
+                    ]
+                }
+
+        class _App:
+            name = "t"
+            slo = _SLO()
+            provenance = store
+
+        rec = IncidentRecorder(
+            directory=str(tmp_path), registry=MetricsRegistry(), app=_App()
+        )
+        bundle = rec.capture({"rule": "slo_burn", "severity": "critical"})
+        records = bundle["provenance"]["records"]
+        assert [r["request_id"] for r in records] == ["breach-1"]
+        assert records[0]["instance_id"] == "gen-x"
+        text = render_incident_text(bundle)
+        assert "decisions:" in text
+        assert "breach-1" in text
+
+    def test_bundle_without_provenance_names_it_missing(self, tmp_path):
+        from predictionio_tpu.obs.incident import IncidentRecorder
+
+        class _App:
+            name = "t"
+
+        rec = IncidentRecorder(
+            directory=str(tmp_path), registry=MetricsRegistry(), app=_App()
+        )
+        bundle = rec.capture({"rule": "manual"})
+        assert "provenance" not in bundle or not bundle.get("provenance")
