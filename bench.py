@@ -1033,6 +1033,7 @@ def bench_fleet_day_section(n_replicas: int):
                 "requests": verdict.get("requests"),
             },
         }
+        out.update(bench_tenant_day_metrics(env, repo))
         log(
             f"# fleet_day scenario={out['fleet_day_scenario']} "
             f"verdict={'PASS' if out['fleet_day_verdict_pass'] else 'FAIL'} "
@@ -1044,6 +1045,67 @@ def bench_fleet_day_section(n_replicas: int):
         return out
     finally:
         shutil.rmtree(day_home, ignore_errors=True)
+
+
+def bench_tenant_day_metrics(env, repo):
+    """The two-tenant isolation half of the fleet_day section (schema v9):
+    replay the in-process quota-flood day (``replay.tenant_day``) in a
+    subprocess — the victim tenant's availability and tail latency under a
+    neighbor's 10× flood are the gate metrics; the isolation verdict rides
+    along as a diagnostic."""
+    import subprocess
+    import tempfile
+
+    report_path = os.path.join(
+        tempfile.mkdtemp(prefix="pio-bench-tenant-day-"), "report.json"
+    )
+    code = (
+        "import sys; from predictionio_tpu.replay.tenant_day import "
+        "run_tenant_day; rc, _ = run_tenant_day(report_path=sys.argv[1], "
+        "out=lambda s: None); sys.exit(rc)"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code, report_path],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=300,
+    )
+    try:
+        with open(report_path) as f:
+            report = json.load(f)
+    except (OSError, ValueError):
+        log(
+            f"# fleet_day tenant-day run failed (exit {proc.returncode}): "
+            f"{proc.stderr[-400:]}"
+        )
+        return {"fleet_day_tenant_isolation_pass": False}
+    clauses = {
+        c["clause"]: bool(c["passed"])
+        for c in report["verdict"].get("clauses", [])
+    }
+    victims = [
+        r for r in report.get("tenants", []) if not r.get("quota_shed")
+    ]
+    victim_avail = min(
+        (r.get("availability") for r in victims if r.get("availability") is not None),
+        default=None,
+    )
+    victim_p99 = max(
+        (r.get("p99_ms") for r in victims if r.get("p99_ms") is not None),
+        default=None,
+    )
+    out = {
+        "fleet_day_tenant_isolation_pass": clauses.get(
+            "tenant_isolation", False
+        ),
+        "fleet_day_tenant_victim_availability": victim_avail,
+        "fleet_day_tenant_victim_p99_ms": victim_p99,
+        "fleet_day_tenants": report.get("tenants"),
+    }
+    log(
+        f"# fleet_day tenant isolation="
+        f"{'PASS' if out['fleet_day_tenant_isolation_pass'] else 'FAIL'} "
+        f"victim_availability={victim_avail} victim_p99={victim_p99}ms"
+    )
+    return out
 
 
 def serving_p50_concurrent(model, num_users, clients=32, per_client=40):

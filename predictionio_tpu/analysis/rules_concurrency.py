@@ -325,3 +325,110 @@ def _self_attr_target(tgt: ast.AST) -> str | None:
     ):
         return tgt.attr
     return None
+
+
+#: constructors of per-tenant serving state.  One instance of any of these
+#: parked in a module-level global is shared by every tenant co-resident in
+#: the replica — exactly the cross-tenant leak the TenantRegistry exists to
+#: prevent (docs/robustness.md#multi-tenancy).  Matched by terminal class
+#: name so `QualityMonitor()`, `quality.QualityMonitor()`, and an aliased
+#: import all resolve; generic process infrastructure (MetricsRegistry,
+#: thread pools, lock witnesses) is deliberately NOT listed — those are
+#: process-scoped by design.
+_TENANT_STATE_CTORS = frozenset(
+    (
+        "QualityMonitor",
+        "SLOTracker",
+        "CostLedger",
+        "TokenBucket",
+        "DeployedEngine",
+        "TenantRegistry",
+        "Tenant",
+    )
+)
+
+
+def _tenant_state_ctor(mod: ModuleInfo, expr: ast.AST) -> str | None:
+    """Terminal class name when expr constructs per-tenant state."""
+    if not isinstance(expr, ast.Call):
+        return None
+    callee = resolve_call(mod, expr)
+    name = callee.rsplit(".", 1)[-1]
+    return name if name in _TENANT_STATE_CTORS else None
+
+
+@rule
+class ModuleLevelTenantSingleton(Rule):
+    """PIO-CONC004: module-level singleton holding per-tenant state.
+
+    Two shapes, both the `default_quality()` pattern family:
+
+    * eager — ``_MONITOR = QualityMonitor()`` at module scope
+    * lazy  — a function that does ``global _MONITOR`` and assigns it a
+      per-tenant-state constructor result (memoized getter)
+
+    Either way the instance is per-*process*: the moment a replica hosts a
+    second tenant, both tenants' quality windows / SLO burn / quota state
+    land in the same object.  Per-tenant state must be owned by the
+    Tenant/TenantRegistry (or passed in explicitly), never reached through
+    a module global.  Function-local and instance-attribute construction
+    is fine and not flagged.
+    """
+
+    id = "PIO-CONC004"
+    severity = Severity.HIGH
+    summary = (
+        "module-level singleton of per-tenant state; every tenant in the "
+        "replica shares it — own it in the TenantRegistry instead"
+    )
+
+    def check(self, mod: ModuleInfo) -> Iterable[Finding]:
+        for node in mod.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            cls = _tenant_state_ctor(mod, node.value)
+            if cls and any(isinstance(t, ast.Name) for t in node.targets):
+                name = next(
+                    t.id for t in node.targets if isinstance(t, ast.Name)
+                )
+                yield self.finding(
+                    mod,
+                    node,
+                    f"module-level {cls} singleton {name!r}: every tenant "
+                    "in the replica shares this instance, so one tenant's "
+                    "state bleeds into another's; construct it per tenant "
+                    "and own it in the TenantRegistry",
+                )
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            declared: set[str] = set()
+            for sub in walk_skipping_defs(fn.body):
+                if isinstance(sub, ast.Global):
+                    declared.update(sub.names)
+            if not declared:
+                continue
+            for sub in walk_skipping_defs(fn.body):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                cls = _tenant_state_ctor(mod, sub.value)
+                if cls is None:
+                    continue
+                hit = next(
+                    (
+                        t.id
+                        for t in sub.targets
+                        if isinstance(t, ast.Name) and t.id in declared
+                    ),
+                    None,
+                )
+                if hit is not None:
+                    yield self.finding(
+                        mod,
+                        sub,
+                        f"lazy module-level {cls} singleton {hit!r} "
+                        f"(global in {fn.name!r}): the memoized instance "
+                        "is per-process, so co-resident tenants share it; "
+                        "construct per-tenant state in the TenantRegistry "
+                        "or thread it through explicitly",
+                    )

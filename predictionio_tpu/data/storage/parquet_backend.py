@@ -226,6 +226,25 @@ def _metrics() -> dict[str, Any]:
                         "p99 of pio_event_visibility_lag_seconds (alertable "
                         "scalar mirror)",
                     ),
+                    # the per-tenant split of the two families above: the
+                    # fleet-global pair stays (dashboards + the default
+                    # freshness_lag alert rule key on it); these carry the
+                    # app label so one tenant's compaction backlog is
+                    # attributable — and alertable — without implicating
+                    # its neighbors
+                    "visibility_lag_app": REGISTRY.histogram(
+                        "pio_event_app_visibility_lag_seconds",
+                        "Event-to-visible lag per app: publish-to-compaction "
+                        "age of each row folded out of the hot tier",
+                        labelnames=("app",),
+                        buckets=TRAIN_BUCKETS,
+                    ),
+                    "visibility_lag_app_p99": REGISTRY.gauge(
+                        "pio_event_app_visibility_lag_p99_seconds",
+                        "p99 of pio_event_app_visibility_lag_seconds per app "
+                        "(alertable scalar mirror)",
+                        labelnames=("app",),
+                    ),
                 }
     return _M
 
@@ -1461,14 +1480,21 @@ class ParquetEventStore:
         with self.client.compact_lock:
             tombs = self._tombstones(d)
             for k, shard_dir in self.shard_dirs(app_id, channel_id):
-                total += self._compact_shard(shard_dir, tombs)
+                total += self._compact_shard(
+                    shard_dir, tombs, app_label=str(app_id)
+                )
             self._prune_tombstones(d)
         m = _metrics()
         m["compactions"].inc()
         m["compact_s"].observe(time.perf_counter() - t0)
         return total
 
-    def _compact_shard(self, shard_dir: Path, tombs: dict[str, int]) -> int:
+    def _compact_shard(
+        self,
+        shard_dir: Path,
+        tombs: dict[str, int],
+        app_label: str | None = None,
+    ) -> int:
         cseg, hots, superseded, _ = _active_segments(shard_dir)
         # never fold past an in-flight write: a writer that reserved its
         # seq before this fold started may publish its segment AFTER the
@@ -1562,7 +1588,16 @@ class ParquetEventStore:
                     rows = 1
                 lag = max(lag_now - s.seq / 1e9, 0.0)
                 m["visibility_lag"].observe_many(lag, rows)
+                if app_label is not None:
+                    m["visibility_lag_app"].labels(app_label).observe_many(
+                        lag, rows
+                    )
             m["visibility_lag_p99"].set(m["visibility_lag"].quantile(0.99))
+            if app_label is not None:
+                h_app = m["visibility_lag_app"].labels(app_label)
+                m["visibility_lag_app_p99"].labels(app_label).set(
+                    h_app.quantile(0.99)
+                )
         for s in folded + superseded:
             if s.path != new_path or t is None:
                 s.path.unlink(missing_ok=True)

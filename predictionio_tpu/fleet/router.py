@@ -73,6 +73,8 @@ log = logging.getLogger("predictionio_tpu.fleet")
 _PASSTHROUGH_HEADERS = (
     "X-Pio-Engine-Instance",
     "X-Pio-Variant",
+    "X-Pio-App",
+    "X-Pio-Shed-Reason",
     "X-Pio-Degraded",
     "Retry-After",
 )
@@ -152,6 +154,18 @@ def _payload_entity(payload: Any) -> str | None:
     return None
 
 
+def _request_app(req: Any) -> str | None:
+    """The tenant (app) a request names, if any: X-Pio-App header or
+    ``?app=`` query."""
+    headers = getattr(req, "headers", None) or {}
+    for k, v in headers.items():
+        if k.lower() == "x-pio-app" and v:
+            return str(v)
+    q = getattr(req, "query", None) or {}
+    v = q.get("app")
+    return str(v) if v else None
+
+
 def create_router_app(
     fleet: FleetState,
     access_key: str | None = None,
@@ -228,6 +242,12 @@ def create_router_app(
         if rid:
             headers[REQUEST_ID_HEADER] = rid
         headers.update(propagation_headers())
+        # the tenant identity travels with the forward: the replica's
+        # per-tenant gate needs to know WHO is asking, whichever replica
+        # the rendezvous order lands on
+        tenant_app = _request_app(req)
+        if tenant_app:
+            headers["X-Pio-App"] = tenant_app
         timeout = forward_timeout_s
         if deadline_left is not None:
             # decrement the forwarded budget by what this hop already
@@ -252,7 +272,17 @@ def create_router_app(
                 raise ValueError("query must be a JSON object")
         except Exception as e:
             return error_response(400, f"invalid query: {e}")
-        order = fleet.route_order(_payload_entity(payload))
+        # affinity keys on (app, entity): two tenants sharing an entity id
+        # space must NOT share per-user cache/canary homes — tenant A's
+        # "user1" and tenant B's "user1" are different people
+        entity = _payload_entity(payload)
+        tenant_app = _request_app(req)
+        affinity = (
+            f"{tenant_app}|{entity}"
+            if tenant_app and entity is not None
+            else entity
+        )
+        order = fleet.route_order(affinity)
         if not order:
             return shed_response("no routable replicas", 1.0)
         last_shed: Response | None = None

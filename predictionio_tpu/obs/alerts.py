@@ -233,6 +233,22 @@ def default_rule_pack() -> list[AlertRule]:
             "lag is over a minute: the freshness SLO input is degrading "
             "(compaction stalled or backlogged)",
         ),
+        AlertRule(
+            "tenant_quota_shed_rate", "metric:pio_tenant_shed_total", 1.0,
+            rate=True, for_s=10.0, clear_band=0.5, severity="warning",
+            labels={"reason": "tenant_quota"},
+            description="a tenant is being shed at its quota gate faster "
+            "than 1 req/s sustained: a noisy neighbor is flooding (each "
+            "firing instance carries the offending app label)",
+        ),
+        AlertRule(
+            "tenant_hbm_overcommit",
+            "metric:pio_tenant_hbm_refused_total", 0.0, rate=True,
+            for_s=0.0, severity="warning",
+            description="the HBM bin-packer refused a tenant residency "
+            "admission: the replica's device-memory budget is overcommitted "
+            "(the firing instance's app label names the refused tenant)",
+        ),
     ]
 
 

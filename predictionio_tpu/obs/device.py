@@ -853,9 +853,14 @@ def als_plan_roofline(plan: Mapping[str, Any]) -> dict[str, float] | None:
 #: (``fleet_day_device_s``), the verdict booleans as diagnostics, and the
 #: ``fleet_day_scenario`` config echo the gate refuses to cross-compare
 #: (a calm day vs one with a mid-peak SIGKILL is not the same
-#: measurement).  ``pio bench --compare`` refuses version-less or older
-#: files.
-BENCH_SCHEMA_VERSION = 8
+#: measurement); v9 grows the ``fleet_day`` section with the two-tenant
+#: isolation run (``replay.tenant_day``): the noisy-neighbor verdict
+#: (``fleet_day_tenant_isolation_pass``), the innocent tenant's
+#: availability under a neighbor's 10× quota flood
+#: (``fleet_day_tenant_victim_availability``) and its tail latency
+#: (``fleet_day_tenant_victim_p99_ms``).  ``pio bench --compare``
+#: refuses version-less or older files.
+BENCH_SCHEMA_VERSION = 9
 
 #: regression-gateable BENCH metrics and which direction is better.  Only
 #: keys present in BOTH files are compared; everything else (configuration
@@ -917,6 +922,10 @@ BENCH_GATE_METRICS: dict[str, str] = {
     "fleet_day_shed_rate": "lower",
     "fleet_day_retry_rate": "lower",
     "fleet_day_device_s": "lower",
+    # two-tenant isolation run (schema v9): an innocent neighbor's
+    # availability and tail under a co-tenant's quota flood must not decay
+    "fleet_day_tenant_victim_availability": "higher",
+    "fleet_day_tenant_victim_p99_ms": "lower",
 }
 
 

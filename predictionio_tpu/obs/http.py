@@ -93,6 +93,7 @@ _OBS_PATHS = frozenset(
         "/eventstore.json",
         "/locks.json",
         "/explain.json",
+        "/tenants.json",
         "/healthz",
         "/readyz",
         "/slo.json",
@@ -127,6 +128,20 @@ def record_request_outcome(app, req, resp, duration_s: float, span) -> None:
             trace_id=trace_id,
             request_id=getattr(span, "request_id", None),
         )
+    # per-tenant SLO scoping: the admission gate stamped the resolved
+    # tenant on the request; its OWN tracker records the outcome too, so
+    # tenant A's errors burn A's budget and only A's (server-wide slo
+    # above stays the whole-replica view)
+    tenant = getattr(req, "tenant", None)
+    if tenant is not None:
+        tslo = getattr(tenant, "slo", None)
+        if tslo is not None and tslo is not slo:
+            tslo.record(
+                resp.status < 500,
+                duration_s,
+                trace_id=trace_id,
+                request_id=getattr(span, "request_id", None),
+            )
     provenance: ProvenanceStore | None = getattr(app, "provenance", None)
     if provenance is not None:
         # assemble the answer's decision record from the capture scope the
@@ -182,6 +197,7 @@ def add_observability_routes(
     incidents: Any | None = None,
     costs: Any | None = None,
     provenance: ProvenanceStore | None = None,
+    tenants: Any | None = None,
 ):
     """The full observability surface: metrics + logs + flight + profiler +
     health.  Installs ``app.slo`` / ``app.flight`` / ``app.readiness`` so
@@ -251,6 +267,8 @@ def add_observability_routes(
         app.incidents = incidents
     if costs is not None:
         app.costs = costs
+    if tenants is not None:
+        app.tenants = tenants
     ring = get_log_ring()
 
     original_route = app.route
@@ -346,6 +364,25 @@ def add_observability_routes(
                         400, {"message": "windows must be an integer"}
                     )
             return json_response(200, app.costs.snapshot(windows=windows))
+
+    # -- tenant registry -----------------------------------------------------
+    # on the SCRAPE surface like /costs.json (gated when a key is
+    # configured): `pio tenants --url`, `pio status --url`, the dashboard's
+    # tenant table, and federation all read this one snapshot
+    if tenants is not None:
+
+        @route("GET", "/tenants\\.json")
+        def tenants_json(req: Request) -> Response:
+            snap = app.tenants.snapshot()
+            want = req.query.get("app")
+            if want is not None:
+                rows = [t for t in snap["tenants"] if t.get("app") == want]
+                if not rows:
+                    return json_response(
+                        404, {"error": "unknown_tenant", "app": want}
+                    )
+                snap = dict(snap, tenants=rows)
+            return json_response(200, snap)
 
     if not debug_routes:
         _add_health_routes(app, route)

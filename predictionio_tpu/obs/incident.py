@@ -168,11 +168,22 @@ class IncidentRecorder:
         spans: list[dict[str, Any]] = []
         for tid in trace_ids:
             spans.extend(self._fragments.fragments(tid))
+        # a per-tenant alert instance keys as "app=name[,...]": surface the
+        # offending tenant as a first-class field so incident triage (and
+        # `pio incidents`) names the neighbor without parsing keys
+        tenant = None
+        key = event.get("key")
+        if isinstance(key, str):
+            for part in key.split(","):
+                if part.startswith("app="):
+                    tenant = part[len("app="):]
+                    break
         bundle: dict[str, Any] = {
             "format": BUNDLE_FORMAT,
             "id": incident_id,
             "rule": rule,
             "key": event.get("key"),
+            "tenant": tenant,
             "severity": event.get("severity"),
             "value": event.get("value"),
             "at": event.get("at") or round(time.time(), 3),

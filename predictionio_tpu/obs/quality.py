@@ -682,17 +682,24 @@ class QualityMonitor:
     # -- feedback side (event-server ingest) ---------------------------------
 
     def observe_feedback(
-        self, event: Any, request_id: str | None = None, ts: float | None = None
+        self,
+        event: Any,
+        request_id: str | None = None,
+        ts: float | None = None,
+        app: Any = None,
     ) -> bool:
         """Join one ingested event back to a logged prediction.  Returns
-        True when joined.  Never raises."""
+        True when joined.  Never raises.  ``app`` (the ingest call's
+        authenticated app id/name) is stamped on the joined record so a
+        multi-tenant quality surface can attribute — and audit — which
+        tenant's feedback joined which prediction."""
         try:
-            return self._observe_feedback(event, request_id, ts)
+            return self._observe_feedback(event, request_id, ts, app)
         except Exception:  # pragma: no cover - defensive
             log.debug("observe_feedback failed", exc_info=True)
             return False
 
-    def _observe_feedback(self, event, request_id, ts) -> bool:
+    def _observe_feedback(self, event, request_id, ts, app=None) -> bool:
         if event.event not in self.feedback_events:
             return False
         ts = ts if ts is not None else _now()
@@ -725,6 +732,8 @@ class QualityMonitor:
                 return False
             if item is not None:
                 rec["actual"][str(item)] = rating
+            if app is not None:
+                rec["app"] = app
             vstats = self._vstats(rec["variant"])
             vstats["feedback"] += 1
             if not rec["joined"]:

@@ -28,7 +28,10 @@ exemplar request id), plus a per-phase table.  The clause catalog:
   ``tolerance`` of the capacity model's recommendation;
 - ``fault_reconciliation`` — EXACTLY one incident bundle per injected
   fault, naming its rule; missing, duplicate, or spurious bundles fail
-  the run.
+  the run;
+- ``tenant_isolation`` (multi-tenant days only) — every flooded tenant
+  is actually shed (quota engaged), every innocent neighbor holds its
+  availability/p99, and zero answers cross a tenant boundary.
 """
 
 from __future__ import annotations
@@ -135,7 +138,11 @@ def evaluate_day(evidence: Mapping[str, Any]) -> dict[str, Any]:
     - ``instances``: ``{known: [...], new, flip_completed_s}`` (offsets
       in day seconds);
     - ``stall_windows``: ``[[start_s, end_s], ...]`` write-shed amnesty
-      windows (storage stalls actually injected).
+      windows (storage stalls actually injected);
+    - ``tenants``: ``{rows: [{app, scheduled, answered, ok, quota_shed,
+      leaked, availability, p99_ms, p99_bound_ms?}], flooded: [...],
+      availability_floor}`` — presence enables the ``tenant_isolation``
+      clause.
     """
     phases = list(evidence.get("phases", []))
     outcomes = list(evidence.get("outcomes", []))
@@ -257,12 +264,25 @@ def evaluate_day(evidence: Mapping[str, Any]) -> dict[str, Any]:
         # answered (shed) after the stall lifts
         return any(w0 - 1.0 <= t <= w1 + 5.0 for w0, w1 in stall_windows)
 
+    # a 503 stamped reason=tenant_quota from a tenant the scenario
+    # deliberately flooded is the admission contract WORKING, not a lost
+    # read — same spirit as the storage-stall write amnesty above
+    flooded_apps = set((evidence.get("tenants") or {}).get("flooded", []))
+
+    def excused_quota_shed(o: dict) -> bool:
+        return (
+            int(o["status"]) == 503
+            and o.get("shed_reason") == "tenant_quota"
+            and o.get("app") in flooded_apps
+        )
+
     read_failures = [
         o["id"]
         for o in outcomes
         if o.get("kind") == "read"
         and o.get("status") is not None
         and not 200 <= int(o["status"]) < 300
+        and not excused_quota_shed(o)
     ]
     write_failures = [
         o["id"]
@@ -414,6 +434,62 @@ def evaluate_day(evidence: Mapping[str, Any]) -> dict[str, Any]:
             },
         }
     )
+
+    # -- clause: tenant_isolation -------------------------------------------
+    # only evaluated for multi-tenant days (evidence carries a "tenants"
+    # block built by the tenant-day harness); single-tenant days are
+    # unaffected.  Containment means three things at once: the flooded
+    # tenant IS shed (quota engaged, reason=tenant_quota), every innocent
+    # neighbor keeps its availability/p99, and no answer ever crosses a
+    # tenant boundary (X-Pio-App / engine-instance leakage).
+    ten_ev = evidence.get("tenants")
+    if ten_ev is not None:
+        rows = list(ten_ev.get("rows", []))
+        flooded = set(ten_ev.get("flooded", []))
+        floor = float(ten_ev.get("availability_floor", 0.99))
+        leaks = [
+            {"app": r.get("app"), "leaked": r.get("leaked")}
+            for r in rows
+            if int(r.get("leaked", 0) or 0)
+        ]
+        unshed = [
+            r.get("app")
+            for r in rows
+            if r.get("app") in flooded and not int(r.get("quota_shed", 0) or 0)
+        ]
+        starved = []
+        for r in rows:
+            if r.get("app") in flooded:
+                continue
+            avail = r.get("availability")
+            if avail is None or float(avail) < floor:
+                starved.append({"app": r.get("app"), "availability": avail})
+            bound = r.get("p99_bound_ms")
+            p99 = r.get("p99_ms")
+            if bound is not None and p99 is not None and p99 > bound:
+                starved.append(
+                    {"app": r.get("app"), "p99_ms": p99, "bound_ms": bound}
+                )
+        ten_ok = not leaks and not unshed and not starved
+        clauses.append(
+            {
+                "clause": "tenant_isolation",
+                "passed": ten_ok,
+                "detail": (
+                    f"{len(rows)} tenant(s), {len(flooded)} flooded; "
+                    f"leaks={len(leaks)}, quota-not-engaged={unshed or 'none'}, "
+                    f"starved-neighbors={len(starved)}"
+                ),
+                "evidence": {
+                    "metric": "pio_tenant_shed_total",
+                    "availability_floor": floor,
+                    "rows": rows,
+                    "leaks": leaks,
+                    "flooded_without_shed": unshed,
+                    "starved": starved,
+                },
+            }
+        )
 
     return {
         "pass": all(c["passed"] for c in clauses),

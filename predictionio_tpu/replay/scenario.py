@@ -26,14 +26,19 @@ __all__ = ["Scenario", "ScenarioPhase", "ScenarioAction", "ScenarioError", "ACTI
 #: SIGKILLs a spawned replica mid-traffic, "canary_flip" deploys a new
 #: engine generation and hot-swaps every replica onto it, "storage_stall"
 #: arms a latency fault plan on the event-store write seam for a bounded
-#: window (and disarms it after)
-ACTION_KINDS = frozenset({"kill_replica", "canary_flip", "storage_stall"})
+#: window (and disarms it after), "quota_flood" drives one named tenant at
+#: a multiple of its admission quota so the day proves noisy-neighbor
+#: containment (docs/robustness.md#multi-tenancy)
+ACTION_KINDS = frozenset(
+    {"kill_replica", "canary_flip", "storage_stall", "quota_flood"}
+)
 
 #: the incident-bundle rule each injected action must reconcile against —
 #: the verdict engine demands EXACTLY one bundle per injection
 ACTION_EXPECTED_RULE = {
     "kill_replica": "breaker_open",
     "storage_stall": "ingest_shed",
+    "quota_flood": "tenant_quota_shed_rate",
     # canary_flip is a clean deploy: it must NOT produce a bundle
 }
 
@@ -93,6 +98,12 @@ class Scenario:
     max_inflight: int = 64
     ingest_max_inflight: int | None = None
     slo: dict[str, Any] = field(default_factory=dict)
+    #: multi-tenant days: ``[{name, quota_rps?, quota_burst?, weight?}]``
+    #: — each entry becomes a resident tenant; ``weight`` splits the
+    #: phase qps across tenants, ``quota_rps`` arms the tenant's
+    #: admission token bucket so a ``quota_flood`` action has a ceiling
+    #: to overrun
+    tenants: tuple[dict[str, Any], ...] = ()
 
     # -- loading -------------------------------------------------------------
 
@@ -180,6 +191,45 @@ class Scenario:
         slo = doc.get("slo", {})
         if slo and not isinstance(slo, Mapping):
             raise ScenarioError("slo", "must be a JSON object")
+        tenants_doc = doc.get("tenants", []) or []
+        if not isinstance(tenants_doc, list):
+            raise ScenarioError("tenants", "must be an array")
+        tenants: list[dict[str, Any]] = []
+        seen_names: set[str] = set()
+        for i, t in enumerate(tenants_doc):
+            where = f"tenants[{i}]"
+            if not isinstance(t, Mapping):
+                raise ScenarioError(where, "must be a JSON object")
+            name = t.get("name")
+            if not name or not isinstance(name, str):
+                raise ScenarioError(f"{where}.name", "required string")
+            if name in seen_names:
+                raise ScenarioError(f"{where}.name", f"duplicate tenant {name!r}")
+            seen_names.add(name)
+            quota = _num(t, "quota_rps", where)
+            if quota is not None and quota <= 0:
+                raise ScenarioError(f"{where}.quota_rps", "must be > 0")
+            burst = _num(t, "quota_burst", where)
+            weight = _num(t, "weight", where, default=1.0)
+            if weight <= 0:
+                raise ScenarioError(f"{where}.weight", "must be > 0")
+            tenants.append(
+                {
+                    "name": name,
+                    "quota_rps": quota,
+                    "quota_burst": burst,
+                    "weight": weight,
+                }
+            )
+        for i, a in enumerate(actions):
+            if a.kind == "quota_flood":
+                target = a.params.get("tenant")
+                if not target or target not in seen_names:
+                    raise ScenarioError(
+                        f"actions[{i}].tenant",
+                        f"quota_flood must name a declared tenant, "
+                        f"got {target!r}; have {sorted(seen_names)}",
+                    )
         ingest_max = doc.get("ingest_max_inflight")
         return cls(
             name=str(doc.get("name", "day")),
@@ -193,6 +243,7 @@ class Scenario:
             max_inflight=int(doc.get("max_inflight", 64)),
             ingest_max_inflight=None if ingest_max is None else int(ingest_max),
             slo=dict(slo),
+            tenants=tuple(tenants),
         )
 
     # -- derived -------------------------------------------------------------
@@ -246,4 +297,5 @@ class Scenario:
                 {"at_s": a.at_s, "kind": a.kind, **a.params} for a in self.actions
             ],
             "slo": dict(self.slo),
+            "tenants": [dict(t) for t in self.tenants],
         }

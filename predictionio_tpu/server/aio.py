@@ -110,7 +110,7 @@ async def _observe_app_request(
 ) -> Response:
     """The accounted (non-observability) request path: admission control,
     deadline binding, root span, SLO + flight accounting."""
-    adm, shed = admit_request(app)
+    adm, shed = admit_request(app, req)
     if shed is not None:
         return shed
     budget = request_budget(app, req)

@@ -480,6 +480,65 @@ def _esc_num(v) -> str:
     return f"{v:g}" if isinstance(v, (int, float)) else "n/a"
 
 
+def _tenants_html(serving_url: str, access_key: str | None = None) -> str:
+    """Tenants panel: a running replica's /tenants.json — one row per
+    resident tenant (SLO state, quota burn, resident HBM bytes, in-flight
+    count, degraded reasons).  A dead replica costs one bounded fetch and
+    renders as a one-line notice (the dashboard must not die with it)."""
+    import urllib.request
+
+    headers = {}
+    if access_key:
+        headers["Authorization"] = f"Bearer {access_key}"
+    base = serving_url.rstrip("/")
+    try:
+        req = urllib.request.Request(
+            base + "/tenants.json", headers=headers
+        )
+        with urllib.request.urlopen(req, timeout=3.0) as r:
+            body = json.loads(r.read().decode("utf-8"))
+    except Exception as e:
+        return (
+            "<h2>Tenants</h2><p>replica at "
+            f"<code>{html.escape(serving_url)}</code> unreachable: "
+            f"{html.escape(str(e))}</p>"
+        )
+    # gated drill-down links reuse the single-`?` access-key join: the key
+    # (when configured) claims the `?`, every further param joins with `&`
+    # — a second `?` would truncate the query string at the replica
+    key_q = f"?accessKey={quote(access_key)}" if access_key else ""
+    amp = "&" if access_key else "?"
+    rows = []
+    for t in body.get("tenants", []):
+        slo = t.get("slo") or {}
+        quota = t.get("quota") or {}
+        degraded = ",".join(t.get("degraded") or []) or "-"
+        name = str(t.get("app"))
+        link = f"{base}/tenants.json{key_q}{amp}app={quote(name)}"
+        rows.append(
+            f"<tr><td><a href='{html.escape(link)}'>"
+            f"{html.escape(name)}</a></td>"
+            f"<td>{html.escape(str(slo.get('status')))}</td>"
+            f"<td>{_esc_num(slo.get('availability'))}</td>"
+            f"<td>{quota.get('denied', 0) if quota else '-'}</td>"
+            f"<td>{t.get('hbm_bytes', 0)}</td>"
+            f"<td>{t.get('inflight', 0)}</td>"
+            f"<td>{html.escape(degraded)}</td></tr>"
+        )
+    budget = body.get("hbm_budget_bytes")
+    return (
+        f"<h2>Tenants</h2><p>{body.get('count', 0)} resident, HBM "
+        f"{body.get('hbm_resident_bytes', 0)}"
+        + (f"/{budget}" if budget else "")
+        + f" bytes (replica: <code>{html.escape(serving_url)}</code>)</p>"
+        "<table border='1'><tr><th>app</th><th>slo</th>"
+        "<th>availability</th><th>quota denied</th><th>hbm bytes</th>"
+        "<th>inflight</th><th>degraded</th></tr>"
+        + "".join(rows)
+        + "</table>"
+    )
+
+
 def _alerts_html(
     app: HTTPApp, fleet_url: str | None = None, access_key: str | None = None
 ) -> str:
@@ -607,6 +666,7 @@ def create_dashboard_app(
     quality: QualityMonitor | None = None,
     trace_sources: list[str] | None = None,
     fleet_url: str | None = None,
+    serving_url: str | None = None,
 ) -> HTTPApp:
     """``access_key`` gates every route (Dashboard.scala:47 mixes in
     KeyAuthentication); TLS comes from the AppServer layer below.
@@ -619,7 +679,12 @@ def create_dashboard_app(
 
     ``fleet_url`` (default: ``PIO_FLEET_URL``) names a fleet router whose
     ``/fleet.json`` renders as the Fleet panel — replica membership,
-    ejections, and per-replica capacity at a glance."""
+    ejections, and per-replica capacity at a glance.
+
+    ``serving_url`` (default: ``PIO_SERVING_URL``) names a prediction
+    replica whose ``/tenants.json`` renders as the Tenants panel — one
+    row per resident tenant with SLO state, quota burn, resident HBM
+    bytes, and degraded reasons (docs/robustness.md#multi-tenancy)."""
     storage = storage or get_storage()
     app = HTTPApp("dashboard", access_key=access_key)
     quality = quality or default_quality()
@@ -631,6 +696,8 @@ def create_dashboard_app(
         ]
     if fleet_url is None:
         fleet_url = os.environ.get("PIO_FLEET_URL") or None
+    if serving_url is None:
+        serving_url = os.environ.get("PIO_SERVING_URL") or None
 
     def _metadata_ready() -> bool:
         storage.evaluation_instances().get_completed()
@@ -692,6 +759,11 @@ def create_dashboard_app(
             + (
                 _fleet_html(fleet_url, access_key=access_key)
                 if fleet_url
+                else ""
+            )
+            + (
+                _tenants_html(serving_url, access_key=access_key)
+                if serving_url
                 else ""
             )
             + f"{quality_html}"

@@ -294,7 +294,9 @@ def create_event_server_app(
             # echoed on this ingest call (bound to the request context by
             # the front end), then the event's own prId / pioRequestId,
             # then entity id within the join window (observe_feedback)
-            quality.observe_feedback(event, request_id=get_request_id())
+            quality.observe_feedback(
+                event, request_id=get_request_id(), app=auth.app_id
+            )
         if hourly is not None:
             hourly.update(
                 auth.app_id,

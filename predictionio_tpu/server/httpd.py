@@ -135,10 +135,16 @@ def exception_response(e: Exception) -> Response:
 def request_budget(app: "HTTPApp", req: Request) -> float | None:
     """The request's time budget in seconds: the ``X-Pio-Deadline`` header
     when present (malformed values are ignored, not 500s), else the
-    server's ``default_deadline_s`` (None = no deadline)."""
+    request's tenant's deadline default (stamped on ``req`` by the
+    admission gate), else the server's ``default_deadline_s`` (None = no
+    deadline)."""
     budget = parse_budget(header_get(req.headers, DEADLINE_HEADER))
     if budget is None:
-        budget = getattr(app, "default_deadline_s", None)
+        tenant = getattr(req, "tenant", None)
+        if tenant is not None and tenant.default_deadline_s is not None:
+            budget = tenant.default_deadline_s
+        else:
+            budget = getattr(app, "default_deadline_s", None)
     return budget
 
 
@@ -150,21 +156,51 @@ def _record_slo_failure(app: "HTTPApp") -> None:
         slo.record(False, 0.0)
 
 
-def admit_request(app: "HTTPApp"):
-    """In-flight admission gate shared by both HTTP front ends.
+class _CompositeRelease:
+    """Release both the server-wide admission slot and the per-tenant one
+    in one ``release()`` — what ``admit_request`` hands the front ends
+    when a tenant registry is configured."""
 
-    Returns ``(controller, None)`` when admitted — ``controller`` is what
-    the caller must ``release()`` in its finally (None when no cap is
-    configured) — or ``(None, 503-shed-response)`` when rejected: past the
+    __slots__ = ("_parts",)
+
+    def __init__(self, *parts):
+        self._parts = [p for p in parts if p is not None]
+
+    def release(self) -> None:
+        for p in self._parts:
+            p.release()
+
+
+def admit_request(app: "HTTPApp", req: Request | None = None):
+    """Admission gate shared by both HTTP front ends: the server-wide
+    in-flight cap, then (when ``app.tenants`` is a TenantRegistry and the
+    request is given) the per-tenant gate — quota token bucket and
+    per-tenant in-flight cap, shed with ``reason=tenant_quota`` /
+    ``tenant_inflight`` BEFORE the query reaches the MicroBatcher.
+
+    Returns ``(releaser, None)`` when admitted — ``releaser`` is what the
+    caller must ``release()`` in its finally (None when no cap is
+    configured) — or ``(None, 503-shed-response)`` when rejected: past a
     cap, shedding NOW is cheaper for everyone than queueing into a
     timeout."""
     adm = getattr(app, "admission", None)
-    if adm is None or adm.try_acquire():
+    if adm is not None and not adm.try_acquire():
+        _record_slo_failure(app)
+        return None, shed_response(
+            "server over capacity; retry later", adm.retry_after_s
+        )
+    tenants = getattr(app, "tenants", None)
+    if tenants is None or req is None:
         return adm, None
-    _record_slo_failure(app)
-    return None, shed_response(
-        "server over capacity; retry later", adm.retry_after_s
-    )
+    tenant, releaser, shed = tenants.gate(req)
+    if shed is not None:
+        # the tenant's own SLO already burned inside gate(); the victim is
+        # contained — the server-wide SLO does NOT burn for a per-tenant
+        # shed, so one flooding tenant cannot page the whole replica
+        if adm is not None:
+            adm.release()
+        return None, shed
+    return _CompositeRelease(adm, releaser), None
 
 
 def admission_expired_response(app: "HTTPApp") -> Response:
@@ -314,7 +350,7 @@ def observe_request(
         resp = call(req)
         resp.headers.setdefault(REQUEST_ID_HEADER, rid)
         return resp
-    adm, shed = admit_request(app)
+    adm, shed = admit_request(app, req)
     if shed is not None:
         shed.headers.setdefault(REQUEST_ID_HEADER, rid)
         return shed

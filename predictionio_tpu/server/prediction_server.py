@@ -81,6 +81,12 @@ from predictionio_tpu.server.httpd import (
     key_matches,
     shed_response,
 )
+from predictionio_tpu.tenancy import (
+    APP_HEADER,
+    Tenant,
+    TenantRegistry,
+    TokenBucket,
+)
 from predictionio_tpu.utils.params import extract_params
 
 log = logging.getLogger("predictionio_tpu.serving")
@@ -577,6 +583,14 @@ def create_prediction_server_app(
     #: decision-provenance ring (docs/observability.md#decision-provenance):
     #: None = a fresh default-capacity store; tests pass sized ones
     provenance_store: ProvenanceStore | None = None,
+    #: multi-tenant serving (docs/robustness.md#multi-tenancy): a
+    #: TenantRegistry whose resident tenants this replica serves — None
+    #: wraps ``deployed`` in a single default tenant (legacy behavior).
+    #: With a registry, per-request tenant resolution (X-Pio-App header /
+    #: ?app= / access key) routes each query to ITS tenant's engine,
+    #: quality monitor, SLO tracker, and cost identity, and the front-end
+    #: choke points enforce per-tenant quotas and in-flight caps.
+    tenants: "TenantRegistry | None" = None,
 ) -> HTTPApp:
     import os
 
@@ -631,6 +645,36 @@ def create_prediction_server_app(
         or getattr(deployed.instance, "engine_id", None)
         or "engine"
     )
+
+    # -- tenant registry (docs/robustness.md#multi-tenancy) ------------------
+    # single-engine deployments wrap ``deployed`` in ONE default tenant so
+    # both front ends run the same choke points (quota -> in-flight cap ->
+    # per-tenant SLO) whether a replica hosts one engine or ten.  The
+    # implicit wrap declares hbm_bytes=0: there is nothing to bin-pack
+    # against and the engine is already resident.
+    if tenants is None:
+        tenants = TenantRegistry(registry=registry)
+    if tenants.default is None:
+        tenants.admit(
+            Tenant(
+                cost_app,
+                deployed,
+                quality=quality,
+                cost_name=cost_app,
+                hbm_bytes=0,
+            )
+        )
+    default_tenant = tenants.default
+    app.tenants = tenants
+
+    def _req_tenant(req: Request) -> Tenant:
+        # the front-end gate (httpd.admit_request) stamps req.tenant after
+        # the quota/in-flight checks; resolution here only covers callers
+        # that drive handlers directly (tests, tooling)
+        t = getattr(req, "tenant", None)
+        if t is None:
+            t = tenants.resolve(req) or default_tenant
+        return t
 
     # -- model lifecycle: generation manifest + canary + controller ----------
     from predictionio_tpu.lifecycle.controller import (
@@ -746,6 +790,7 @@ def create_prediction_server_app(
         incidents=incidents,
         costs=costs,
         provenance=provenance_store,
+        tenants=tenants,
     )
     # the evaluator daemon starts when a server actually starts serving
     # (AppServer/AsyncAppServer honor this flag), NOT at app construction:
@@ -824,6 +869,7 @@ def create_prediction_server_app(
                 # the micro-batch queue is idle
                 "inflightGenerations": deployed.inflight_snapshot(),
                 "batcherBusy": bool(batcher is not None and batcher.busy),
+                "apps": tenants.apps(),
                 **stats,
             },
         )
@@ -831,25 +877,30 @@ def create_prediction_server_app(
     # bad query JSON/shape -> 400; engine/server faults -> logged 500
     # (the reference's MappingException / Throwable split,
     # CreateServer.scala:607-630)
-    def _parse_query(req: Request):
+    def _parse_query(req: Request, dep=None):
         payload = req.json()
         if not isinstance(payload, dict):
             raise ValueError("query must be a JSON object")
-        return payload, deployed.extract_query(payload)
+        return payload, (dep or deployed).extract_query(payload)
 
-    def _finish_query(payload, query, prediction, t0: float, binding=None) -> Response:
+    def _finish_query(
+        tenant, payload, query, prediction, t0: float, binding=None
+    ) -> Response:
         return _finish_rendered(
-            payload, query, _render_prediction(prediction), t0, binding
+            tenant, payload, query, _render_prediction(prediction), t0, binding
         )
 
-    def _finish_rendered(payload, query, rendered, t0: float, binding=None) -> Response:
+    def _finish_rendered(
+        tenant, payload, query, rendered, t0: float, binding=None
+    ) -> Response:
+        dep = tenant.deployed
         instance_id = (
-            binding.instance.id if binding is not None else deployed.instance.id
+            binding.instance.id if binding is not None else dep.instance.id
         )
         answered_variant = (
-            deployed.binding_label(binding)
+            dep.binding_label(binding)
             if binding is not None
-            else variant_label
+            else dep.variant_label
         )
         rendered = plugins.process_output(instance_id, payload, rendered)
         if feedback.enabled and feedback.app_id is not None:
@@ -867,7 +918,7 @@ def create_prediction_server_app(
             stats["avg_serving_sec"] = (stats["avg_serving_sec"] * n + dt) / (n + 1)
             stats["last_serving_sec"] = dt
             stats["request_count"] = n + 1
-        quality.observe_prediction(
+        (tenant.quality or quality).observe_prediction(
             get_request_id(), payload, rendered, variant=answered_variant
         )
         # the decision record keeps what was actually returned — item ids
@@ -876,6 +927,7 @@ def create_prediction_server_app(
         resp = json_response(200, rendered)
         resp.headers[INSTANCE_HEADER] = instance_id
         resp.headers[VARIANT_HEADER] = answered_variant
+        resp.headers[APP_HEADER] = tenant.name
         return resp
 
     if use_microbatch:
@@ -884,11 +936,11 @@ def create_prediction_server_app(
             PendingWave,
         )
 
-        def _postprocess(payload, query, prediction):
+        def _postprocess(dep, payload, query, prediction):
             """Render + plugins + feedback — the blocking tail, on the
             worker thread so the event loop stays free for I/O."""
             rendered = plugins.process_output(
-                deployed.instance.id, payload, _render_prediction(prediction)
+                dep.instance.id, payload, _render_prediction(prediction)
             )
             if feedback.enabled and feedback.app_id is not None:
                 try:
@@ -897,7 +949,7 @@ def create_prediction_server_app(
                     log.error("feedback event failed: %s", e)
             return rendered
 
-        def _predict_bisect(binding, parsed, idxs, out, depth=0):
+        def _predict_bisect(dep, binding, parsed, idxs, out, depth=0):
             """Batched predict with bisection fault isolation: a failing
             wave splits in half and each half retries batched, so P poison
             queries cost O(P log B) extra dispatches instead of turning the
@@ -905,7 +957,7 @@ def create_prediction_server_app(
             against ONE captured binding — a swap mid-wave cannot mix
             generations inside a wave."""
             try:
-                results = deployed.predict_batch_bound(
+                results = dep.predict_batch_bound(
                     binding, [parsed[i][1] for i in idxs]
                 )
             except DeadlineExceeded:
@@ -925,13 +977,13 @@ def create_prediction_server_app(
                         "wave predict failed; bisecting to isolate"
                     )
                 mid = len(idxs) // 2
-                _predict_bisect(binding, parsed, idxs[:mid], out, depth + 1)
-                _predict_bisect(binding, parsed, idxs[mid:], out, depth + 1)
+                _predict_bisect(dep, binding, parsed, idxs[:mid], out, depth + 1)
+                _predict_bisect(dep, binding, parsed, idxs[mid:], out, depth + 1)
                 return
             for i, (q, pred) in zip(idxs, results):
                 out[i] = ("pred", (q, pred))
 
-        def _serve_wave(payloads):
+        def _serve_wave(items):
             """One wave, split at the fence (docs/performance.md).
 
             The DISPATCH half runs here on the worker thread: extract,
@@ -951,29 +1003,53 @@ def create_prediction_server_app(
             partition whose engines lack async dispatch (or whose dispatch
             fails) computes synchronously in the finalize half — still off
             the worker's critical path — with the bisection fault
-            isolation unchanged: a poison query degrades only itself."""
-            live_b = deployed.live_binding()
-            canary_b, fraction = deployed.canary_split()
+            isolation unchanged: a poison query degrades only itself.
+
+            Multi-tenancy: the batcher carries ``(tenant, payload)``
+            items, so one wave may span tenants.  The wave partitions
+            first by tenant, then by that tenant's live/canary split —
+            each tenant's bindings are captured ONCE per wave (swap
+            atomicity holds per tenant), and every partition dispatches,
+            fences, bills, and releases against ITS tenant's engine.  A
+            neighbor's poison query or corrupt generation therefore fails
+            only its own partition."""
+            wave_tenants: list[Any] = []
+            for t, _ in items:
+                if not any(t is wt for wt in wave_tenants):
+                    wave_tenants.append(t)
+            payloads = [pl for _, pl in items]
+            # (live, canary, fraction) per tenant, captured once per wave
+            splits: dict[int, tuple[Any, Any, float]] = {}
+            for t in wave_tenants:
+                live_b = t.deployed.live_binding()
+                canary_b, fraction = t.deployed.canary_split()
+                splits[id(t)] = (live_b, canary_b, fraction)
             bindings: list[Any] = []
-            for pl in payloads:
+            for t, pl in items:
+                live_b, canary_b, fraction = splits[id(t)]
                 b = live_b
                 if canary_b is not None and in_canary_fraction(
-                    deployed.payload_entity(pl), fraction
+                    t.deployed.payload_entity(pl), fraction
                 ):
                     b = canary_b
                 bindings.append(b)
             routes = [
-                (b.instance.id, deployed.binding_label(b)) for b in bindings
+                (b.instance.id, t.deployed.binding_label(b))
+                for (t, _), b in zip(items, bindings)
             ]
             # the decision record's identity half, once per binding (the
             # generation lookup is memoized); engine-side detail collects
             # per partition through the wave-scoped provenance collector
             # (the request scope is invisible on worker/finalizer threads)
-            base_prov = {
-                id(b): provenance.binding_fields(deployed, b)
-                for b in (live_b, canary_b)
-                if b is not None
-            }
+            base_prov: dict[int, dict[str, Any]] = {}
+            for t in wave_tenants:
+                live_b, canary_b, _fr = splits[id(t)]
+                for b in (live_b, canary_b):
+                    if b is not None:
+                        base_prov[id(b)] = dict(
+                            provenance.binding_fields(t.deployed, b),
+                            app=t.name,
+                        )
             part_notes: dict[int, dict[str, Any]] = {}
 
             def _merge_wave_notes(b, wtoken) -> None:
@@ -985,42 +1061,46 @@ def create_prediction_server_app(
                     notes.setdefault("_deep", {}).update(deep)
 
             parsed: list[tuple[str, Any]] = []
-            partitions: list[tuple[Any, list[int], Any]] = []
+            partitions: list[tuple[Any, Any, list[int], Any]] = []
             with degraded_scope() as degraded:
-                for pl in payloads:
+                for t, pl in items:
                     try:
-                        parsed.append(("q", deployed.extract_query(pl)))
+                        parsed.append(("q", t.deployed.extract_query(pl)))
                     except Exception as e:
                         parsed.append(("bad", e))
                 out: list[Any] = [(tag, v, ()) for tag, v in parsed]
-                for b in (live_b, canary_b):
-                    if b is None:
-                        continue
-                    ok_idx = [
-                        i for i, (tag, _) in enumerate(parsed)
-                        if tag == "q" and bindings[i] is b
-                    ]
-                    if not ok_idx:
-                        continue
-                    deployed.acquire_slot(b)
-                    fin = None
-                    wtoken = provenance.begin_wave()
-                    try:
-                        fin = deployed.dispatch_batch_bound(
-                            b, [parsed[i][1] for i in ok_idx]
-                        )
-                    except Exception:
-                        # dispatch failed before the fence: the finalize
-                        # half re-runs this partition synchronously with
-                        # bisection, which attributes the real poison
-                        log.exception(
-                            "async wave dispatch failed; partition falls "
-                            "back to the synchronous path"
-                        )
+                for t in wave_tenants:
+                    live_b, canary_b, _fr = splits[id(t)]
+                    for b in (live_b, canary_b):
+                        if b is None:
+                            continue
+                        ok_idx = [
+                            i for i, (tag, _) in enumerate(parsed)
+                            if tag == "q" and bindings[i] is b
+                        ]
+                        if not ok_idx:
+                            continue
+                        dep = t.deployed
+                        dep.acquire_slot(b)
                         fin = None
-                    finally:
-                        _merge_wave_notes(b, wtoken)
-                    partitions.append((b, ok_idx, fin))
+                        wtoken = provenance.begin_wave()
+                        try:
+                            fin = dep.dispatch_batch_bound(
+                                b, [parsed[i][1] for i in ok_idx]
+                            )
+                        except Exception:
+                            # dispatch failed before the fence: the
+                            # finalize half re-runs this partition
+                            # synchronously with bisection, which
+                            # attributes the real poison
+                            log.exception(
+                                "async wave dispatch failed; partition "
+                                "falls back to the synchronous path"
+                            )
+                            fin = None
+                        finally:
+                            _merge_wave_notes(b, wtoken)
+                        partitions.append((t, b, ok_idx, fin))
                 degraded_pre = tuple(degraded)
 
             def _finalize():
@@ -1028,11 +1108,14 @@ def create_prediction_server_app(
                 try:
                     with degraded_scope() as degraded:
                         while remaining:
-                            b, ok_idx, fin = remaining[0]
+                            t, b, ok_idx, fin = remaining[0]
+                            dep = t.deployed
                             wtoken = provenance.begin_wave()
                             try:
                                 if fin is None:
-                                    _predict_bisect(b, parsed, ok_idx, out)
+                                    _predict_bisect(
+                                        dep, b, parsed, ok_idx, out
+                                    )
                                 else:
                                     try:
                                         results = fin()
@@ -1048,7 +1131,7 @@ def create_prediction_server_app(
                                             "bisecting to isolate"
                                         )
                                         _predict_bisect(
-                                            b, parsed, ok_idx, out
+                                            dep, b, parsed, ok_idx, out
                                         )
                                     else:
                                         for i, (q, pred) in zip(
@@ -1057,7 +1140,7 @@ def create_prediction_server_app(
                                             out[i] = ("pred", (q, pred))
                             finally:
                                 _merge_wave_notes(b, wtoken)
-                                deployed.release_slot(b)
+                                dep.release_slot(b)
                                 remaining.pop(0)
                         for i, entry in enumerate(out):
                             if entry[0] != "pred":
@@ -1066,7 +1149,10 @@ def create_prediction_server_app(
                             try:
                                 out[i] = (
                                     "ok",
-                                    _postprocess(payloads[i], q, pred),
+                                    _postprocess(
+                                        items[i][0].deployed,
+                                        payloads[i], q, pred,
+                                    ),
                                     (),
                                 )
                             except Exception as e:  # plugin error: only
@@ -1075,8 +1161,8 @@ def create_prediction_server_app(
                             d for d in degraded if d not in degraded_pre
                         )
                 except BaseException:
-                    for b, _, _ in remaining:
-                        deployed.release_slot(b)
+                    for t, b, _, _ in remaining:
+                        t.deployed.release_slot(b)
                     raise
 
                 def _prov_item(i: int) -> dict[str, Any]:
@@ -1098,7 +1184,7 @@ def create_prediction_server_app(
                     for i, entry in enumerate(out)
                 ]
 
-            if all(fin is None for _, _, fin in partitions):
+            if all(fin is None for _, _, _, fin in partitions):
                 # nothing dispatched async (host-replica or sharded
                 # engines): compute inline on the worker thread — keeping
                 # the worker busy is what lets queue pressure coalesce the
@@ -1144,6 +1230,8 @@ def create_prediction_server_app(
                 _observe("/queries.json", 400, t0)
                 return error_response(400, f"invalid query: {e}")
             clock.lap("parse")
+            tenant = _req_tenant(req)
+            t_variant = tenant.deployed.variant_label
             # the worker fills meta with this query's queue-wait/device
             # split + wave mates; annotate() hands it to the flight recorder
             meta: dict[str, Any] = {}
@@ -1153,7 +1241,7 @@ def create_prediction_server_app(
                 with trace("serve.microbatch", record=False) as mb_span:
                     clock.lap("route")
                     status, value, degraded, route_info, prov_item = (
-                        await batcher.submit(payload, meta)
+                        await batcher.submit((tenant, payload), meta)
                     )
                     # decompose the await window: queued wait + the wave's
                     # device-stage split, leftover = loop wakeup + future
@@ -1173,7 +1261,7 @@ def create_prediction_server_app(
                 # bounded queue: shed instead of letting the backlog grow —
                 # clients get an honest 503 + Retry-After
                 _observe("/queries.json", 503, t0)
-                costs.note_shed(cost_app, "/queries.json", variant_label)
+                costs.note_shed(tenant.cost_name, "/queries.json", t_variant)
                 return shed_response(str(e), e.retry_after_s)
             except DeadlineExceeded as e:
                 # the budget ran out while queued (or mid-wave): no point
@@ -1181,7 +1269,7 @@ def create_prediction_server_app(
                 # seconds it held were real, so they still bill
                 _observe("/queries.json", 504, t0)
                 costs.bill_meta(
-                    cost_app, "/queries.json", variant_label, meta,
+                    tenant.cost_name, "/queries.json", t_variant, meta,
                     queue_only=True,
                 )
                 return error_response(504, f"deadline exceeded: {e}")
@@ -1193,7 +1281,7 @@ def create_prediction_server_app(
                 if meta:
                     annotate(**meta)
             instance_id, answered_variant = route_info or (
-                deployed.instance.id, variant_label,
+                tenant.deployed.instance.id, t_variant,
             )
             # the decision record: the wave item's binding identity +
             # engine notes, the wave coordinates, and the cache split —
@@ -1234,11 +1322,12 @@ def create_prediction_server_app(
             # member either way, and conservation (ledger sums == aggregate
             # device counters) only holds if every share lands somewhere
             costs.bill_meta(
-                cost_app, "/queries.json", answered_variant, meta
+                tenant.cost_name, "/queries.json", answered_variant, meta
             )
             def _stamped(resp: Response) -> Response:
                 resp.headers[INSTANCE_HEADER] = instance_id
                 resp.headers[VARIANT_HEADER] = answered_variant
+                resp.headers[APP_HEADER] = tenant.name
                 return resp
 
             if status == "bad":
@@ -1263,7 +1352,7 @@ def create_prediction_server_app(
                 "canary" if answered_variant == CANARY_VARIANT else "live",
                 200, time.perf_counter() - t0,
             )
-            quality.observe_prediction(
+            (tenant.quality or quality).observe_prediction(
                 get_request_id(),
                 payload,
                 value,
@@ -1298,50 +1387,57 @@ def create_prediction_server_app(
             # the whole solo path runs on this thread, so one bound
             # RequestCost catches its storage reads directly; the predict
             # window's measured device time + XLA cost bill on exit
+            tenant = _req_tenant(req)
             with request_cost(
-                cost_app, "/queries.json", variant_label, ledger=costs
+                tenant.cost_name, "/queries.json",
+                tenant.deployed.variant_label, ledger=costs,
             ) as cost_rec:
-                return _solo_query(req, cost_rec)
+                return _solo_query(req, tenant, cost_rec)
 
-        def _solo_query(req: Request, cost_rec) -> Response:
+        def _solo_query(req: Request, tenant, cost_rec) -> Response:
             t0 = time.perf_counter()
             clock = StageClock()
+            dep = tenant.deployed
 
             def _stamped(resp: Response, binding=None) -> Response:
                 # every answer — errors included — names the generation
                 # that (would have) answered, so 5xx attribution works
                 # exactly when it matters most
                 resp.headers[INSTANCE_HEADER] = (
-                    binding.instance.id if binding else deployed.instance.id
+                    binding.instance.id if binding else dep.instance.id
                 )
                 resp.headers[VARIANT_HEADER] = (
-                    deployed.binding_label(binding) if binding else variant_label
+                    dep.binding_label(binding)
+                    if binding
+                    else dep.variant_label
                 )
+                resp.headers[APP_HEADER] = tenant.name
                 return resp
 
             try:
-                payload, query = _parse_query(req)
+                payload, query = _parse_query(req, dep)
             except Exception as e:
                 _observe("/queries.json", 400, t0)
                 return _stamped(error_response(400, f"invalid query: {e}"))
             clock.lap("parse")
-            binding = deployed.binding_for_entity(
-                deployed.payload_entity(payload)
+            binding = dep.binding_for_entity(
+                dep.payload_entity(payload)
             )
-            cost_rec.variant = deployed.binding_label(binding)
+            cost_rec.variant = dep.binding_label(binding)
             # the decision record's identity half: payload + generation +
             # hash-side (memoized manifest read — cheap-capture budget)
             provenance.note(
                 payload=payload,
-                **provenance.binding_fields(deployed, binding),
+                app=tenant.name,
+                **provenance.binding_fields(dep, binding),
             )
             annotate(
                 instance_id=binding.instance.id,
-                variant=deployed.binding_label(binding),
+                variant=dep.binding_label(binding),
             )
             clock.lap("route")
             try:
-                with deployed.serving_slot(binding), degraded_scope() as degraded:
+                with dep.serving_slot(binding), degraded_scope() as degraded:
                     # the wave timeline collects the engine's stage marks
                     # (supplement's host_gather, any device h2d/compute/d2h)
                     # so the predict window splits into named stages; the
@@ -1350,7 +1446,7 @@ def create_prediction_server_app(
                     t_pred = time.perf_counter()
                     try:
                         with device_obs.wave_timeline() as timeline:
-                            query, prediction = deployed.predict_bound(
+                            query, prediction = dep.predict_bound(
                                 binding, query
                             )
                     finally:
@@ -1402,7 +1498,7 @@ def create_prediction_server_app(
             )
             if degraded:
                 provenance.note(degraded=list(degraded))
-            resp = _finish_query(payload, query, prediction, t0, binding)
+            resp = _finish_query(tenant, payload, query, prediction, t0, binding)
             if degraded:
                 resp.headers["X-Pio-Degraded"] = ",".join(degraded)
             resp.encoded()
@@ -1420,23 +1516,31 @@ def create_prediction_server_app(
         """Hot-swap to the latest COMPLETED instance — gated behind the
         generation manifest: the candidate's blob checksum and
         ``sanity_check()`` run BEFORE the flip, and any refusal answers
-        409 with the reason while the old generation keeps serving."""
+        409 with the reason while the old generation keeps serving.
+        Tenant-scoped: ``?app=`` / the X-Pio-App header picks WHICH
+        resident engine reloads — a corrupt candidate 409s only its own
+        tenant, every neighbor's generation is untouched."""
         if not _authorized(req):
             return error_response(401, "Invalid accessKey.")
+        t = _req_tenant(req)
         try:
-            inst = deployed.reload_latest()
+            inst = t.deployed.reload_latest()
         except Exception as e:
             # verification refused the candidate (corrupt blob, failed
             # sanity check, no completed instance): 409, old model serves on
-            log.error("reload refused: %s", e)
+            log.error("reload refused (app=%s): %s", t.name, e)
             return json_response(
                 409,
                 {
                     "message": f"reload refused: {e}",
-                    "engineInstanceId": deployed.instance.id,
+                    "app": t.name,
+                    "engineInstanceId": t.deployed.instance.id,
                 },
             )
-        return json_response(200, {"message": "Reloaded", "engineInstanceId": inst.id})
+        return json_response(
+            200,
+            {"message": "Reloaded", "app": t.name, "engineInstanceId": inst.id},
+        )
 
     @app.route("GET", "/lifecycle\\.json")
     def lifecycle_json(req: Request) -> Response:
@@ -1673,3 +1777,65 @@ def create_prediction_server(
         server = AppServer(app, host, port)
     server_ref.append(server)
     return server
+
+
+def deploy_tenant_engines(
+    specs: list[dict],
+    storage: StorageRuntime | None = None,
+    hbm_budget_bytes: int | None = None,
+    registry: MetricsRegistry | None = None,
+) -> TenantRegistry:
+    """Deploy SEVERAL engines into one TenantRegistry — the multi-tenant
+    replica's boot path (``pio deploy --app name=... --app name=...``).
+
+    Each spec is ``{"app": name, "engine_factory": ..., "engine_id": ...,
+    "engine_version": ..., "engine_variant": ..., "engine_instance_id": ...,
+    "quota_rps": ..., "quota_burst": ..., "max_inflight": ...,
+    "default_deadline_s": ..., "access_key": ...}`` (only ``app`` and
+    ``engine_factory`` required).  Admission bin-packs each engine's
+    manifest-declared HBM footprint against ``hbm_budget_bytes``: a tenant
+    that does not fit raises :class:`TenantAdmissionError` naming the
+    shortfall, and already-admitted residents are untouched."""
+    tenants = TenantRegistry(
+        hbm_budget_bytes=hbm_budget_bytes, registry=registry
+    )
+    for spec in specs:
+        dep = deploy_engine(
+            spec.get("engine_factory") or spec.get("engine_factory_name") or "",
+            storage=storage,
+            engine_instance_id=spec.get("engine_instance_id"),
+            engine_id=spec.get("engine_id", "default"),
+            engine_version=spec.get("engine_version", "default"),
+            engine_variant=spec.get("engine_variant", "default"),
+        )
+        quota = None
+        if spec.get("quota_rps"):
+            quota = TokenBucket(
+                float(spec["quota_rps"]), spec.get("quota_burst")
+            )
+        tenants.admit(
+            Tenant(
+                spec["app"],
+                dep,
+                quota=quota,
+                max_inflight=spec.get("max_inflight"),
+                default_deadline_s=spec.get("default_deadline_s"),
+                access_key=spec.get("access_key"),
+            )
+        )
+    return tenants
+
+
+def create_multi_tenant_server_app(
+    tenants: TenantRegistry, **kwargs: Any
+) -> HTTPApp:
+    """A prediction-server app over an ALREADY-POPULATED TenantRegistry:
+    the registry's default tenant anchors the legacy single-engine
+    surfaces (/, /status.json engineInstanceId), every other surface is
+    tenant-resolved per request."""
+    default = tenants.default
+    if default is None:
+        raise ValueError("tenant registry has no resident tenants")
+    return create_prediction_server_app(
+        default.deployed, tenants=tenants, **kwargs
+    )

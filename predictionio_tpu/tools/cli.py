@@ -256,6 +256,47 @@ def _status_remote(
                 "write-hot head (see docs/data_plane.md#compaction)",
                 file=sys.stderr,
             )
+    # multi-tenant surface (404/401-tolerant): one row per resident tenant
+    # — SLO state, quota burn, resident HBM bytes, degraded reasons — so
+    # the operator sees WHICH app is unhealthy, not a blended replica
+    # verdict.  A degraded tenant is a WARNING; the exit code is the
+    # replica's own (a victim tenant being shed is containment WORKING).
+    tn_status, tn_body = fetch("/tenants.json")
+    if tn_status == 200 and isinstance(tn_body.get("tenants"), list):
+        report["tenants"] = {
+            "count": tn_body.get("count"),
+            "hbm_resident_bytes": tn_body.get("hbm_resident_bytes"),
+            "hbm_budget_bytes": tn_body.get("hbm_budget_bytes"),
+            "rows": [
+                {
+                    "app": t.get("app"),
+                    "slo": (t.get("slo") or {}).get("status"),
+                    "availability": (t.get("slo") or {}).get("availability"),
+                    "quota_denied": (t.get("quota") or {}).get("denied"),
+                    "hbm_bytes": t.get("hbm_bytes"),
+                    "inflight": t.get("inflight"),
+                    "degraded": t.get("degraded") or [],
+                }
+                for t in tn_body["tenants"]
+            ],
+        }
+        for t in tn_body["tenants"]:
+            slo_state = (t.get("slo") or {}).get("status")
+            degraded = t.get("degraded") or []
+            if slo_state == "degraded" or degraded:
+                quota = t.get("quota") or {}
+                print(
+                    f"WARNING: tenant {t.get('app')} "
+                    f"slo={slo_state}"
+                    + (f" degraded={','.join(degraded)}" if degraded else "")
+                    + (
+                        f" quota_denied={quota.get('denied')}"
+                        if quota.get("denied")
+                        else ""
+                    )
+                    + " (see docs/robustness.md#multi-tenancy)",
+                    file=sys.stderr,
+                )
     fleet_dead = False
     fl_status, fleet_body = fetch("/fleet.json")
     if fl_status == 200 and isinstance(fleet_body.get("replicas"), list):
@@ -643,12 +684,106 @@ def _engine_coords(args) -> tuple[str, str, str, str]:
     )
 
 
+def _parse_tenant_spec(raw: str) -> dict:
+    """One ``--app`` value -> a deploy_tenant_engines spec dict."""
+    kv: dict[str, str] = {}
+    for part in raw.split(","):
+        if not part.strip():
+            continue
+        k, sep, v = part.partition("=")
+        if not sep:
+            raise SystemExit(
+                f"bad --app spec part {part!r}: expected key=value"
+            )
+        kv[k.strip()] = v.strip()
+    if "name" not in kv or "engine" not in kv:
+        raise SystemExit(
+            "--app spec needs at least name=<app>,engine=<factory>"
+        )
+    spec: dict[str, Any] = {
+        "app": kv["name"],
+        "engine_factory": kv["engine"],
+        "engine_id": kv.get("engine_id", "default"),
+        "engine_version": kv.get("engine_version", "default"),
+        "engine_variant": kv.get("variant", "default"),
+        "engine_instance_id": kv.get("engine_instance_id"),
+        "access_key": kv.get("access_key"),
+    }
+    if kv.get("quota_rps"):
+        spec["quota_rps"] = float(kv["quota_rps"])
+    if kv.get("quota_burst"):
+        spec["quota_burst"] = float(kv["quota_burst"])
+    if kv.get("max_inflight"):
+        spec["max_inflight"] = int(kv["max_inflight"])
+    if kv.get("deadline_s"):
+        spec["default_deadline_s"] = float(kv["deadline_s"])
+    return spec
+
+
+def _deploy_multi_tenant(args, raw_specs: list[str]) -> int:
+    """The ``pio deploy --app ... --app ...`` path: N engines, one replica,
+    hard isolation between them."""
+    from predictionio_tpu.server.aio import AsyncAppServer
+    from predictionio_tpu.server.prediction_server import (
+        create_multi_tenant_server_app,
+        deploy_tenant_engines,
+        undeploy_stale,
+    )
+    from predictionio_tpu.tenancy import TenantAdmissionError
+
+    _load_engine_modules()
+    specs = [_parse_tenant_spec(s) for s in raw_specs]
+    if args.port and undeploy_stale(
+        args.ip, args.port, args.accesskey or None
+    ):
+        print(f"undeployed stale server on port {args.port}")
+    try:
+        tenants = deploy_tenant_engines(
+            specs,
+            storage=get_storage(),
+            hbm_budget_bytes=getattr(args, "hbm_budget_bytes", None),
+        )
+    except TenantAdmissionError as e:
+        # the bin-packer's structured refusal: the operator sees exactly
+        # which tenant is short how many bytes — no neighbor OOMed
+        print(json.dumps(e.to_dict(), indent=2), file=sys.stderr)
+        return 1
+    server_ref: list[Any] = []
+
+    def on_stop():
+        if server_ref:
+            server_ref[0].shutdown()
+
+    app = create_multi_tenant_server_app(
+        tenants,
+        on_stop=on_stop,
+        access_key=args.accesskey or None,
+        max_queue=getattr(args, "max_queue", None),
+        max_inflight=getattr(args, "max_inflight", None),
+        default_deadline_s=getattr(args, "deadline_s", None),
+    )
+    server = AsyncAppServer(app, args.ip, args.port)
+    server_ref.append(server)
+    print(
+        f"Serving {len(tenants)} tenants ({', '.join(tenants.apps())}) on "
+        f"http://{args.ip}:{server.port} (POST /queries.json; the "
+        "X-Pio-App header or ?app= selects the tenant)"
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
 def do_deploy(args) -> int:
     from predictionio_tpu.server.prediction_server import (
         FeedbackConfig,
         create_prediction_server,
     )
 
+    if getattr(args, "app_specs", None):
+        return _deploy_multi_tenant(args, args.app_specs)
     _load_engine_modules()
     factory, engine_id, engine_version, engine_variant = _engine_coords(args)
     if _dase_preflight(factory, skip=args.no_check):
@@ -1326,6 +1461,31 @@ def do_costs(args) -> int:
         )
 
     return _run_watched("pio costs", render_once, args.watch, args.watch_count)
+
+
+def do_tenants(args) -> int:
+    """`pio tenants`: the multi-tenant residency table of a running
+    replica — per-tenant SLO state, quota burn, resident HBM bytes,
+    in-flight count, and degraded reasons (reads ``/tenants.json``)."""
+
+    def render_once() -> None:
+        from predictionio_tpu.tenancy import render_tenants_text
+
+        doc = json.loads(
+            _fetch_url(
+                args.url.rstrip("/") + "/tenants.json",
+                getattr(args, "access_key", None),
+            )
+        )
+        print(
+            json.dumps(doc, indent=2)
+            if args.json
+            else render_tenants_text(doc)
+        )
+
+    return _run_watched(
+        "pio tenants", render_once, args.watch, args.watch_count
+    )
 
 
 def _render_top(
@@ -2691,6 +2851,29 @@ def build_parser() -> argparse.ArgumentParser:
         "Retry-After (PIO_MAX_QUEUE; default 1024, 0 = unbounded)",
     )
     dp.add_argument(
+        "--app",
+        action="append",
+        dest="app_specs",
+        metavar="SPEC",
+        default=None,
+        help="host multiple engines as isolated tenants on ONE replica "
+        "(repeatable).  SPEC is comma-separated key=value pairs: "
+        "name=<app>,engine=<factory> required; optional engine_id=, "
+        "engine_version=, variant=, engine_instance_id=, quota_rps=, "
+        "quota_burst=, max_inflight=, deadline_s=, access_key=.  Each "
+        "tenant gets its own quota/SLO/quality/cost scope; requests pick "
+        "their tenant via the X-Pio-App header or ?app= "
+        "(docs/robustness.md#multi-tenancy)",
+    )
+    dp.add_argument(
+        "--hbm-budget-bytes",
+        type=int,
+        default=None,
+        help="device-memory budget the tenant bin-packer admits against; "
+        "a tenant whose stored generation does not fit is refused loudly "
+        "at deploy time (nothing OOMs later)",
+    )
+    dp.add_argument(
         "--lifecycle",
         action="store_true",
         help="run the closed-loop model-lifecycle controller: drift or "
@@ -3060,6 +3243,42 @@ def build_parser() -> argparse.ArgumentParser:
         help=argparse.SUPPRESS,  # bounded --watch iterations (tests)
     )
     co.set_defaults(fn=do_costs)
+
+    tn = sub.add_parser(
+        "tenants",
+        description="Multi-tenant residency table: per-tenant SLO state, "
+        "quota burn, resident HBM bytes, in-flight count, and degraded "
+        "reasons — from a running replica's /tenants.json "
+        "(docs/robustness.md#multi-tenancy).",
+    )
+    tn.add_argument(
+        "--url",
+        required=True,
+        help="read a running server (e.g. http://127.0.0.1:8000)",
+    )
+    tn.add_argument(
+        "--json", action="store_true",
+        help="raw /tenants.json instead of the text table",
+    )
+    tn.add_argument(
+        "--access-key",
+        default=None,
+        help="access key for key-gated servers (sent as a Bearer header)",
+    )
+    tn.add_argument(
+        "--watch",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="re-render every SECONDS until interrupted",
+    )
+    tn.add_argument(
+        "--watch-count",
+        type=int,
+        default=None,
+        help=argparse.SUPPRESS,  # bounded --watch iterations (tests)
+    )
+    tn.set_defaults(fn=do_tenants)
 
     tp = sub.add_parser(
         "top",

@@ -1,0 +1,36 @@
+"""Fixture: PIO-CONC004 — module-level singleton of per-tenant state."""
+
+from predictionio_tpu.obs.quality import QualityMonitor
+from predictionio_tpu.obs.slo import SLOTracker
+
+MONITOR = QualityMonitor()  # line 6: CONC004 (eager module-level singleton)
+
+_tracker = None
+_plain = None
+
+
+def default_tracker():
+    global _tracker
+    if _tracker is None:
+        _tracker = SLOTracker()  # line 15: CONC004 (lazy global singleton)
+    return _tracker
+
+
+def reset_tracker():
+    global _tracker
+    _tracker = None  # clean: reset to None, nothing constructed
+
+
+def local_monitor():
+    m = QualityMonitor()  # clean: function-local instance
+    return m
+
+
+def plain_global():
+    global _plain
+    _plain = object()  # clean: not a per-tenant state class
+
+
+class Holder:
+    def __init__(self):
+        self.q = QualityMonitor()  # clean: instance-owned, per-tenant-able

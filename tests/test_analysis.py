@@ -114,6 +114,15 @@ class TestRuleCorpus:
             ("PIO-CONC003", 47, "high"),
         ]
 
+    def test_conc004_module_level_tenant_singleton(self):
+        """Eager module-scope construction and the lazy `global` memoized
+        getter both flagged; function-local, instance-owned, reset-to-None,
+        and non-tenant-state globals stay clean."""
+        assert triples("conc004_singleton.py") == [
+            ("PIO-CONC004", 6, "high"),
+            ("PIO-CONC004", 15, "high"),
+        ]
+
     def test_lock001_inversion_single_module(self):
         """Both acquisition paths appear in the report."""
         fs = findings_for("lock001_inversion.py")
@@ -245,6 +254,7 @@ class TestRuleCorpus:
                 "conc001_async.py",
                 "conc002_poll.py",
                 "conc003_lock.py",
+                "conc004_singleton.py",
                 "res001_timeout.py",
                 "res002_swallow.py",
                 "res003_storage_write.py",

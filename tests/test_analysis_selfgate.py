@@ -66,8 +66,13 @@ def test_baseline_did_not_grow():
     The whole-program pass (PIO-LOCK/JAX008) swept the package and added
     exactly ONE justified entry: np.generic.item() in the external
     engine's JSON conversion, a host-side scalar with no device buffer.
-    So the baseline is 11, and new rules are the only allowed growth."""
-    assert len(Baseline.load(BASELINE).entries) == 11
+    So the baseline was 11 through the provenance PR.  The multi-tenant
+    PR's PIO-CONC004 (module-level singletons of per-tenant state) then
+    added exactly TWO justified entries — the deliberate process-default
+    getters default_quality() and default_ledger(), which multi-tenant
+    replicas bypass via the TenantRegistry — and new rules remain the
+    only allowed growth."""
+    assert len(Baseline.load(BASELINE).entries) == 13
 
 
 def test_baseline_has_no_stale_entries():
@@ -95,28 +100,38 @@ def test_busy_wait_fix_stays_fixed():
 def test_obs_modules_lint_clean():
     """The request-lifecycle observability modules (logging, flight, slo,
     profiler, http, tracing, metrics) must be clean under `pio check` with
-    NO new baselined findings and no pragma suppressions — telemetry code
-    runs on every request and gets no lint exemptions."""
+    no pragma suppressions — telemetry code runs on every request and gets
+    no lint exemptions.  The ONLY tolerated findings are the two baselined
+    PIO-CONC004 process-default getters (default_quality/default_ledger),
+    which multi-tenant replicas bypass via the TenantRegistry."""
     report = analyze_paths([PACKAGE / "obs"], root=REPO_ROOT)
     assert report.errors == []
-    assert report.findings == [], "\n".join(f.text() for f in report.findings)
+    remaining, _ = Baseline.load(BASELINE).filter(report.findings)
+    assert remaining == [], "\n".join(f.text() for f in remaining)
+    assert sorted((f.rule, f.file) for f in report.findings) == [
+        ("PIO-CONC004", "predictionio_tpu/obs/costs.py"),
+        ("PIO-CONC004", "predictionio_tpu/obs/quality.py"),
+    ]
     assert report.pragma_suppressed == 0
 
 
 def test_quality_module_lint_clean_with_zero_pragmas():
     """The online model-quality module runs on the serving hot path
     (observe_prediction per request) and the ingest path (observe_feedback
-    per event): it must be `pio check`-clean with NO pragma suppressions
-    and NO baseline entries — same bar as the rest of obs/."""
+    per event): it must be `pio check`-clean with NO pragma suppressions.
+    Its single baseline entry is the PIO-CONC004 process-default getter
+    default_quality() — deliberate, justified, and bypassed by the
+    TenantRegistry's per-tenant monitors — and it must stay the only one."""
     report = analyze_paths([PACKAGE / "obs" / "quality.py"], root=REPO_ROOT)
     assert report.errors == []
-    assert report.findings == [], "\n".join(f.text() for f in report.findings)
+    remaining, _ = Baseline.load(BASELINE).filter(report.findings)
+    assert remaining == [], "\n".join(f.text() for f in remaining)
     assert report.pragma_suppressed == 0
     quality_file = "predictionio_tpu/obs/quality.py"
-    baselined = [
+    entries = [
         e for e in Baseline.load(BASELINE).entries if e.file == quality_file
     ]
-    assert baselined == []
+    assert [(e.rule,) for e in entries] == [("PIO-CONC004",)]
 
 
 def test_provenance_module_lint_clean_with_zero_pragmas():
@@ -323,7 +338,8 @@ def test_conc003_recognizes_contended_lock_wrappers():
     ]
     report = analyze_paths(adopters, root=REPO_ROOT)
     assert report.errors == []
-    assert report.findings == [], "\n".join(f.text() for f in report.findings)
+    remaining, _ = Baseline.load(BASELINE).filter(report.findings)
+    assert remaining == [], "\n".join(f.text() for f in remaining)
 
 
 def test_trace_assemble_smoke():

@@ -1,0 +1,703 @@
+"""Multi-tenant serving with hard isolation (docs/robustness.md#multi-tenancy).
+
+Covers the tenancy package bottom-up — TokenBucket admission quotas,
+TenantRegistry residency bin-packing and the per-request gate — then the
+chaos-isolation end-to-end: one replica, three resident tenants, and three
+injected faults (quota flood, corrupt generation, storage loss), each of
+which must stay contained to exactly the tenant it hits.  Finishes with the
+declarative scenario plumbing (``tenants`` block, ``quota_flood`` action),
+the ``tenant_isolation`` verdict clause, the scripted two-tenant production
+day, and the dashboard's gated tenant drill-down links.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.obs.metrics import MetricsRegistry
+from predictionio_tpu.tenancy import (
+    APP_HEADER,
+    Tenant,
+    TenantAdmissionError,
+    TenantRegistry,
+    TokenBucket,
+    render_tenants_text,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# TokenBucket
+# ---------------------------------------------------------------------------
+
+
+class TestTokenBucket:
+    def test_burst_then_shed_then_refill(self):
+        clk = FakeClock()
+        b = TokenBucket(rate=2.0, burst=3.0, clock=clk)
+        assert [b.try_spend() for _ in range(3)] == [True, True, True]
+        assert b.try_spend() is False  # bucket empty, no time passed
+        clk.advance(0.5)  # 2/s * 0.5s = 1 token back
+        assert b.try_spend() is True
+        assert b.try_spend() is False
+
+    def test_refill_caps_at_burst(self):
+        clk = FakeClock()
+        b = TokenBucket(rate=10.0, burst=2.0, clock=clk)
+        clk.advance(100.0)
+        assert b.tokens == pytest.approx(2.0)
+
+    def test_debit_drives_balance_negative_and_sheds(self):
+        clk = FakeClock()
+        b = TokenBucket(rate=1.0, burst=5.0, clock=clk)
+        b.debit(7.0)  # ledger back-charge: 5 - 7 = -2
+        assert b.tokens == pytest.approx(-2.0)
+        assert b.try_spend() is False
+        clk.advance(3.0)  # -2 + 3 = 1 token: the debt is paid off
+        assert b.try_spend() is True
+
+    def test_retry_after_is_honest(self):
+        clk = FakeClock()
+        b = TokenBucket(rate=2.0, burst=1.0, clock=clk)
+        assert b.try_spend() is True
+        # balance 0, need 1 unit at 2/s -> 0.5s
+        assert b.retry_after_s() == pytest.approx(0.5)
+        clk.advance(0.5)
+        assert b.try_spend() is True
+
+    def test_snapshot_counters(self):
+        clk = FakeClock()
+        b = TokenBucket(rate=1.0, burst=2.0, clock=clk)
+        assert b.try_spend() and b.try_spend()
+        assert not b.try_spend()
+        snap = b.snapshot()
+        assert snap["rate"] == 1.0 and snap["burst"] == 2.0
+        assert snap["spent"] == pytest.approx(2.0)
+        assert snap["denied"] == 1
+        assert snap["tokens"] == pytest.approx(0.0)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            TokenBucket(rate=0.0)
+        with pytest.raises(ValueError):
+            TokenBucket(rate=1.0, burst=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# Tenant + TenantRegistry units
+# ---------------------------------------------------------------------------
+
+
+def _tenant(name: str, hbm: int = 0, **kw) -> Tenant:
+    deployed = types.SimpleNamespace(
+        instance=types.SimpleNamespace(id=f"inst-{name}"), storage=None
+    )
+    return Tenant(name, deployed, hbm_bytes=hbm, **kw)
+
+
+def _req(headers=None, query=None):
+    return types.SimpleNamespace(headers=headers or {}, query=query or {})
+
+
+class TestTenant:
+    def test_inflight_slots(self):
+        t = _tenant("a", max_inflight=1)
+        assert t.try_acquire_slot() is True
+        assert t.try_acquire_slot() is False
+        t.release_slot()
+        assert t.try_acquire_slot() is True
+
+    def test_uncapped_inflight(self):
+        t = _tenant("a")
+        assert all(t.try_acquire_slot() for _ in range(100))
+
+    def test_degraded_reasons_open_breaker(self):
+        t = _tenant("a")
+        t.deployed.storage = types.SimpleNamespace(
+            breakers=lambda: [
+                types.SimpleNamespace(name="events", state="open"),
+                types.SimpleNamespace(name="models", state="closed"),
+            ]
+        )
+        assert t.degraded_reasons() == ["breaker_open:events"]
+
+
+class TestTenantRegistry:
+    def test_admit_default_evict(self):
+        reg = TenantRegistry(registry=MetricsRegistry())
+        a, b = _tenant("a"), _tenant("b")
+        reg.admit(a)
+        reg.admit(b)
+        assert reg.default is a  # first admitted anchors
+        assert reg.apps() == ["a", "b"] and len(reg) == 2
+        with pytest.raises(ValueError, match="already resident"):
+            reg.admit(_tenant("a"))
+        assert reg.evict("b") is b
+        assert reg.evict("b") is None
+        assert reg.apps() == ["a"]
+
+    def test_binpack_refusal_is_structured_and_touches_nothing(self):
+        reg = TenantRegistry(hbm_budget_bytes=100, registry=MetricsRegistry())
+        reg.admit(_tenant("small", hbm=60))
+        with pytest.raises(TenantAdmissionError) as ei:
+            reg.admit(_tenant("big", hbm=50))
+        e = ei.value
+        assert e.app == "big"
+        assert e.required_bytes == 50 and e.free_bytes == 40
+        assert e.budget_bytes == 100 and e.shortfall_bytes == 10
+        assert e.resident == ("small",)
+        assert "short 10 bytes" in str(e)
+        d = e.to_dict()
+        assert d["error"] == "tenant_admission_refused"
+        assert d["app"] == "big" and d["shortfall_bytes"] == 10
+        # the refusal evicted nothing and the resident keeps serving
+        assert reg.apps() == ["small"] and reg.resident_bytes() == 60
+        tenant, rel, shed = reg.gate(_req(headers={APP_HEADER: "small"}))
+        assert shed is None and tenant.name == "small"
+        rel.release()
+        # and the freed space admits a right-sized tenant
+        reg.admit(_tenant("fits", hbm=40))
+        assert reg.apps() == ["fits", "small"]
+
+    def test_resolve_precedence(self):
+        reg = TenantRegistry(registry=MetricsRegistry())
+        a = _tenant("a")
+        b = _tenant("b", access_key="kb")
+        reg.admit(a)
+        reg.admit(b)
+        # header beats query beats key beats default
+        assert (
+            reg.resolve(
+                _req(
+                    headers={APP_HEADER: "b", "Authorization": "Bearer kb"},
+                    query={"app": "a"},
+                )
+            )
+            is b
+        )
+        assert reg.resolve(_req(query={"app": "b"})) is b
+        assert reg.resolve(_req(headers={"Authorization": "Bearer kb"})) is b
+        assert reg.resolve(_req()) is a  # default
+        # unknown app resolves to None, NEVER silently another tenant
+        assert reg.resolve(_req(headers={APP_HEADER: "nope"})) is None
+
+    def test_gate_unknown_app_404(self):
+        reg = TenantRegistry(registry=MetricsRegistry())
+        reg.admit(_tenant("a"))
+        tenant, rel, shed = reg.gate(_req(headers={APP_HEADER: "ghost"}))
+        assert tenant is None and rel is None
+        assert shed.status == 404
+
+    def test_gate_quota_shed(self):
+        reg = TenantRegistry(registry=MetricsRegistry())
+        clk = FakeClock()
+        t = _tenant("a", quota=TokenBucket(rate=1.0, burst=1.0, clock=clk))
+        reg.admit(t)
+        tenant, rel, shed = reg.gate(_req())
+        assert shed is None
+        rel.release()
+        tenant, rel, shed = reg.gate(_req())
+        assert rel is None and shed.status == 503
+        assert shed.headers[APP_HEADER] == "a"
+        assert shed.headers["X-Pio-Shed-Reason"] == "tenant_quota"
+        assert int(shed.headers["Retry-After"]) >= 1
+        # the shed burned the tenant's SLO, visible in its snapshot
+        assert t.slo.snapshot()["requests"] >= 1
+
+    def test_gate_inflight_shed(self):
+        reg = TenantRegistry(registry=MetricsRegistry())
+        reg.admit(_tenant("a", max_inflight=1))
+        _, rel, shed = reg.gate(_req())
+        assert shed is None
+        _, rel2, shed2 = reg.gate(_req())
+        assert rel2 is None and shed2.status == 503
+        assert shed2.headers["X-Pio-Shed-Reason"] == "tenant_inflight"
+        rel.release()
+        rel.release()  # idempotent
+        _, rel3, shed3 = reg.gate(_req())
+        assert shed3 is None
+        rel3.release()
+
+    def test_snapshot_and_text_rendering(self):
+        reg = TenantRegistry(hbm_budget_bytes=1000, registry=MetricsRegistry())
+        reg.admit(_tenant("a", hbm=300, quota=TokenBucket(rate=5.0)))
+        snap = reg.snapshot()
+        assert snap["count"] == 1 and snap["default_app"] == "a"
+        assert snap["hbm_resident_bytes"] == 300
+        assert snap["hbm_free_bytes"] == 700
+        row = snap["tenants"][0]
+        assert row["app"] == "a" and row["engineInstanceId"] == "inst-a"
+        assert row["quota"]["rate"] == 5.0
+        text = render_tenants_text(snap)
+        assert "1 resident, HBM 300/1000 bytes" in text
+        assert "a: slo=" in text
+
+
+# ---------------------------------------------------------------------------
+# Chaos isolation end-to-end: 3 tenants, 3 faults, each contained
+# ---------------------------------------------------------------------------
+
+
+def _http(url, *, method="GET", body=None, headers=None, timeout=10.0):
+    req = urllib.request.Request(
+        url, data=body, headers=headers or {}, method=method
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        try:
+            doc = json.loads(raw) if raw else {}
+        except ValueError:
+            doc = {"raw": raw.decode("utf-8", "replace")}
+        return e.code, dict(e.headers), doc
+
+
+def _query(base, app, user="u1"):
+    return _http(
+        f"{base}/queries.json",
+        method="POST",
+        body=json.dumps({"user": user}).encode(),
+        headers={"Content-Type": "application/json", APP_HEADER: app},
+    )
+
+
+class TestChaosIsolation:
+    """One replica, tenants alpha/beta/gamma.  beta is quota-flooded,
+    beta's next generation is corrupt, gamma loses its storage daemon —
+    and every fault must stay inside the tenant it hit."""
+
+    def test_three_tenants_three_faults_each_contained(self):
+        from predictionio_tpu.replay.tenant_day import build_stub_tenant
+        from predictionio_tpu.server.aio import AsyncAppServer
+        from predictionio_tpu.server.prediction_server import (
+            create_multi_tenant_server_app,
+        )
+
+        tenants = TenantRegistry(registry=MetricsRegistry())
+        alpha = build_stub_tenant("alpha")
+        beta = build_stub_tenant("beta", quota_rps=2.0, quota_burst=2.0)
+        gamma = build_stub_tenant("gamma")
+        for t in (alpha, beta, gamma):
+            tenants.admit(t)
+
+        app = create_multi_tenant_server_app(tenants, use_microbatch=True)
+        server = AsyncAppServer(app, "127.0.0.1", 0).start_background()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            # -- fault 1: quota flood on beta --------------------------------
+            beta_out = [_query(base, "beta", f"u{i}") for i in range(20)]
+            shed = [
+                (s, h)
+                for s, h, _ in beta_out
+                if s == 503 and h.get("X-Pio-Shed-Reason") == "tenant_quota"
+            ]
+            served = [(s, h) for s, h, _ in beta_out if s == 200]
+            assert shed, "the flood never hit beta's quota"
+            assert served, "beta's in-quota traffic must still be served"
+            for s, h in shed:
+                assert h[APP_HEADER] == "beta"  # the 503 names the offender
+                assert int(h["Retry-After"]) >= 1
+            # the victims: alpha and gamma answer every request, fast, and
+            # every answer is stamped with THEIR app + THEIR instance
+            for victim in ("alpha", "gamma"):
+                t0 = time.monotonic()
+                outs = [_query(base, victim, f"v{i}") for i in range(10)]
+                elapsed = time.monotonic() - t0
+                assert [s for s, _, _ in outs] == [200] * 10
+                for s, h, doc in outs:
+                    assert h[APP_HEADER] == victim
+                    assert h["X-Pio-Engine-Instance"] == f"inst-{victim}"
+                    assert doc["servedBy"] == victim  # zero leakage
+                assert elapsed < 10.0
+                assert tenants.get(victim).slo.snapshot()["availability"] == 1.0
+
+            # -- fault 2: corrupt generation behind beta's /reload -----------
+            def _corrupt_reload():
+                raise RuntimeError("model blob checksum mismatch")
+
+            beta.deployed.reload_latest = _corrupt_reload
+            # the admin route rides the same per-tenant gate, so let the
+            # flood-drained bucket refill first (2/s over 1.2s > 1 token)
+            time.sleep(1.2)
+            s, _, doc = _http(
+                f"{base}/reload",
+                method="POST",
+                body=b"{}",
+                headers={"Content-Type": "application/json", APP_HEADER: "beta"},
+            )
+            assert s == 409
+            assert doc["app"] == "beta"  # the refusal names its tenant
+            assert "reload refused" in doc["message"]
+            assert "checksum mismatch" in doc["message"]
+            assert doc["engineInstanceId"] == "inst-beta"
+            # beta keeps serving its OLD generation once its quota refills
+            time.sleep(0.8)
+            s, h, doc = _query(base, "beta", "after-corrupt")
+            assert s == 200 and h["X-Pio-Engine-Instance"] == "inst-beta"
+            # and a neighbor's surfaces never saw the fault
+            s, _, doc = _query(base, "alpha", "still-fine")
+            assert s == 200 and doc["servedBy"] == "alpha"
+
+            # -- fault 3: gamma's storage daemon dies (breaker opens) --------
+            gamma.deployed.storage = types.SimpleNamespace(
+                breakers=lambda: [
+                    types.SimpleNamespace(name="events", state="open")
+                ]
+            )
+            s, _, snap = _http(f"{base}/tenants.json")
+            assert s == 200 and snap["count"] == 3
+            by_app = {t["app"]: t for t in snap["tenants"]}
+            assert by_app["gamma"]["degraded"] == ["breaker_open:events"]
+            assert by_app["alpha"]["degraded"] == []
+            assert by_app["beta"]["degraded"] == []
+            # gamma still answers queries (stub engine needs no storage)
+            s, h, _ = _query(base, "gamma", "post-outage")
+            assert s == 200 and h[APP_HEADER] == "gamma"
+
+            # -- the per-tenant surface filters ------------------------------
+            s, _, one = _http(f"{base}/tenants.json?app=beta")
+            assert s == 200 and [t["app"] for t in one["tenants"]] == ["beta"]
+            assert one["tenants"][0]["quota"]["denied"] > 0
+            s, _, doc = _http(f"{base}/tenants.json?app=nobody")
+            assert s == 404 and doc["error"] == "unknown_tenant"
+            # requests for an unknown app 404 rather than leak to another
+            s, _, _ = _query(base, "nobody")
+            assert s == 404
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Scenario plumbing: the tenants block + quota_flood action
+# ---------------------------------------------------------------------------
+
+
+class TestScenarioTenants:
+    def _doc(self, **extra):
+        doc = {
+            "name": "mt",
+            "phases": [{"duration_s": 10, "qps": 5}],
+        }
+        doc.update(extra)
+        return doc
+
+    def test_tenants_roundtrip(self):
+        from predictionio_tpu.replay.scenario import Scenario
+
+        sc = Scenario.from_dict(
+            self._doc(
+                tenants=[
+                    {"name": "a", "weight": 3},
+                    {"name": "b", "quota_rps": 2.0, "quota_burst": 4.0},
+                ],
+                actions=[{"kind": "quota_flood", "at_s": 2, "tenant": "b"}],
+            )
+        )
+        assert [t["name"] for t in sc.tenants] == ["a", "b"]
+        assert sc.tenants[0]["weight"] == 3.0
+        assert sc.tenants[1]["quota_rps"] == 2.0
+        assert sc.actions[0].expected_rule == "tenant_quota_shed_rate"
+        again = Scenario.from_dict(sc.to_dict())
+        assert again.tenants == sc.tenants
+
+    @pytest.mark.parametrize(
+        "tenants, field",
+        [
+            ([{"name": "a"}, {"name": "a"}], "tenants[1].name"),
+            ([{"quota_rps": 1}], "tenants[0].name"),
+            ([{"name": "a", "quota_rps": 0}], "tenants[0].quota_rps"),
+            ([{"name": "a", "weight": -1}], "tenants[0].weight"),
+            ("nope", "tenants"),
+        ],
+    )
+    def test_malformed_tenants_name_their_field(self, tenants, field):
+        from predictionio_tpu.replay.scenario import Scenario, ScenarioError
+
+        with pytest.raises(ScenarioError) as ei:
+            Scenario.from_dict(self._doc(tenants=tenants))
+        assert ei.value.field == field
+
+    def test_quota_flood_must_name_a_declared_tenant(self):
+        from predictionio_tpu.replay.scenario import Scenario, ScenarioError
+
+        with pytest.raises(ScenarioError) as ei:
+            Scenario.from_dict(
+                self._doc(
+                    tenants=[{"name": "a"}],
+                    actions=[{"kind": "quota_flood", "at_s": 1, "tenant": "z"}],
+                )
+            )
+        assert ei.value.field == "actions[0].tenant"
+        with pytest.raises(ScenarioError):
+            Scenario.from_dict(
+                self._doc(actions=[{"kind": "quota_flood", "at_s": 1}])
+            )
+
+
+# ---------------------------------------------------------------------------
+# Alert pack + verdict clause
+# ---------------------------------------------------------------------------
+
+
+class TestTenantAlertRules:
+    def test_pack_carries_the_tenant_rules(self):
+        from predictionio_tpu.obs.alerts import default_rule_pack
+
+        by_name = {r.name: r for r in default_rule_pack()}
+        shed = by_name["tenant_quota_shed_rate"]
+        assert shed.selector == "metric:pio_tenant_shed_total"
+        assert shed.labels.get("reason") == "tenant_quota"
+        hbm = by_name["tenant_hbm_overcommit"]
+        assert "hbm" in hbm.selector
+
+
+class TestTenantIsolationClause:
+    def _verdict(self, rows, flooded=("beta",), floor=0.99):
+        from predictionio_tpu.obs.verdict import evaluate_day
+
+        v = evaluate_day(
+            {
+                "phases": [],
+                "outcomes": [],
+                "tenants": {
+                    "rows": rows,
+                    "flooded": list(flooded),
+                    "availability_floor": floor,
+                },
+            }
+        )
+        return next(
+            c for c in v["clauses"] if c["clause"] == "tenant_isolation"
+        )
+
+    def _row(self, app, **kw):
+        row = {
+            "app": app,
+            "quota_shed": 0,
+            "leaked": 0,
+            "availability": 1.0,
+            "p99_ms": 5.0,
+            "p99_bound_ms": None,
+        }
+        row.update(kw)
+        return row
+
+    def test_contained_day_passes(self):
+        c = self._verdict(
+            [self._row("alpha"), self._row("beta", quota_shed=40)]
+        )
+        assert c["passed"] is True
+
+    def test_leak_fails(self):
+        c = self._verdict(
+            [self._row("alpha", leaked=1), self._row("beta", quota_shed=40)]
+        )
+        assert c["passed"] is False
+        assert c["evidence"]["leaks"] == [{"app": "alpha", "leaked": 1}]
+
+    def test_quota_never_engaging_fails(self):
+        c = self._verdict([self._row("alpha"), self._row("beta")])
+        assert c["passed"] is False
+        assert c["evidence"]["flooded_without_shed"] == ["beta"]
+
+    def test_starved_neighbor_fails(self):
+        c = self._verdict(
+            [
+                self._row("alpha", availability=0.9),
+                self._row("beta", quota_shed=40),
+            ]
+        )
+        assert c["passed"] is False
+        assert c["evidence"]["starved"][0]["app"] == "alpha"
+
+    def test_neighbor_p99_bound_enforced(self):
+        c = self._verdict(
+            [
+                self._row("alpha", p99_ms=120.0, p99_bound_ms=50.0),
+                self._row("beta", quota_shed=40),
+            ]
+        )
+        assert c["passed"] is False
+
+    def test_single_tenant_days_unaffected(self):
+        from predictionio_tpu.obs.verdict import evaluate_day
+
+        v = evaluate_day({"phases": [], "outcomes": []})
+        assert all(c["clause"] != "tenant_isolation" for c in v["clauses"])
+
+
+# ---------------------------------------------------------------------------
+# The scripted two-tenant production day (quota flood, alert, bundle)
+# ---------------------------------------------------------------------------
+
+
+class TestTenantDay:
+    def test_flood_is_contained_and_bundled(self, tmp_path):
+        from predictionio_tpu.replay.tenant_day import run_tenant_day
+
+        report_path = tmp_path / "report.json"
+        rc, report = run_tenant_day(
+            duration_s=3.0,
+            neighbor_qps=20.0,
+            quota_rps=4.0,
+            flood_factor=10.0,
+            alert_for_s=1.0,
+            incident_dir=str(tmp_path / "incidents"),
+            report_path=str(report_path),
+            out=lambda s: None,
+        )
+        assert rc == 0, json.dumps(report["verdict"], indent=2, default=str)
+        clauses = {
+            c["clause"]: c["passed"] for c in report["verdict"]["clauses"]
+        }
+        assert clauses["tenant_isolation"] is True
+        assert clauses["fault_reconciliation"] is True
+        rows = {r["app"]: r for r in report["tenants"]}
+        assert rows["beta"]["quota_shed"] > 0
+        assert rows["alpha"]["quota_shed"] == 0
+        assert rows["alpha"]["availability"] >= 0.99
+        assert rows["alpha"]["leaked"] == 0 and rows["beta"]["leaked"] == 0
+        # the alert fired and its bundle names the offending tenant
+        bundles = []
+        for name in os.listdir(tmp_path / "incidents"):
+            if name.endswith(".json"):
+                with open(os.path.join(tmp_path, "incidents", name)) as fh:
+                    bundles.append(json.load(fh))
+        assert bundles, "the quota-flood alert never bundled"
+        assert any(
+            b.get("rule") == "tenant_quota_shed_rate"
+            and b.get("tenant") == "beta"
+            for b in bundles
+        )
+        assert report_path.exists()
+
+
+# ---------------------------------------------------------------------------
+# Dashboard tenant table: gated drill-down links (single-? regression)
+# ---------------------------------------------------------------------------
+
+
+class TestDashboardTenantLinks:
+    def _serve(self, access_key=None):
+        from predictionio_tpu.replay.tenant_day import build_stub_tenant
+        from predictionio_tpu.server.aio import AsyncAppServer
+        from predictionio_tpu.server.prediction_server import (
+            create_multi_tenant_server_app,
+        )
+
+        tenants = TenantRegistry(registry=MetricsRegistry())
+        tenants.admit(build_stub_tenant("shop"))
+        app = create_multi_tenant_server_app(
+            tenants, use_microbatch=False, access_key=access_key
+        )
+        return AsyncAppServer(app, "127.0.0.1", 0).start_background()
+
+    def _links(self, html):
+        import re
+
+        return [
+            m.replace("&amp;", "&")
+            for m in re.findall(r"href='([^']+)'", html)
+            if "tenants.json" in m
+        ]
+
+    def test_gated_links_join_query_params_with_single_question_mark(self):
+        from predictionio_tpu.server.dashboard import _tenants_html
+
+        server = self._serve(access_key="sekrit")
+        try:
+            html = _tenants_html(
+                f"http://127.0.0.1:{server.port}", access_key="sekrit"
+            )
+        finally:
+            server.shutdown()
+        links = self._links(html)
+        assert links, html
+        for link in links:
+            assert link.count("?") == 1  # the regression: never "?a=1?b=2"
+            assert "accessKey=sekrit" in link and "app=shop" in link
+
+    def test_ungated_links_still_carry_the_app_param(self):
+        from predictionio_tpu.server.dashboard import _tenants_html
+
+        server = self._serve()
+        try:
+            html = _tenants_html(f"http://127.0.0.1:{server.port}")
+        finally:
+            server.shutdown()
+        links = self._links(html)
+        assert links and all(
+            link.count("?") == 1 and "app=shop" in link for link in links
+        )
+        assert "accessKey" not in html
+
+    def test_unreachable_serving_url_degrades_to_a_notice(self):
+        from predictionio_tpu.server.dashboard import _tenants_html
+
+        html = _tenants_html("http://127.0.0.1:9")  # discard port: refused
+        assert "Tenants" in html and "unreachable" in html
+
+
+# ---------------------------------------------------------------------------
+# Satellite: ingest stamps the authenticated app onto quality joins
+# ---------------------------------------------------------------------------
+
+
+class TestQualityJoinAppStamp:
+    def test_observe_feedback_stamps_app_on_the_joined_record(self):
+        from predictionio_tpu.data import DataMap, Event
+        from predictionio_tpu.obs.quality import QualityMonitor
+
+        m = QualityMonitor(
+            registry=MetricsRegistry(), feedback_events=("rate",)
+        )
+        m.observe_prediction("r1", {"user": "u1"}, {"itemScores": []})
+        ev = Event(
+            event="rate",
+            entity_type="user",
+            entity_id="u1",
+            target_entity_type="item",
+            target_entity_id="i1",
+            properties=DataMap({"rating": 4.0}),
+        )
+        assert m.observe_feedback(ev, request_id="r1", app="shop") is True
+        assert m._by_rid["r1"]["app"] == "shop"
+
+    def test_app_stays_unset_for_single_tenant_ingest(self):
+        from predictionio_tpu.data import DataMap, Event
+        from predictionio_tpu.obs.quality import QualityMonitor
+
+        m = QualityMonitor(
+            registry=MetricsRegistry(), feedback_events=("rate",)
+        )
+        m.observe_prediction("r1", {"user": "u1"}, {"itemScores": []})
+        ev = Event(
+            event="rate",
+            entity_type="user",
+            entity_id="u1",
+            target_entity_type="item",
+            target_entity_id="i1",
+            properties=DataMap({}),
+        )
+        assert m.observe_feedback(ev, request_id="r1") is True
+        assert "app" not in m._by_rid["r1"]
